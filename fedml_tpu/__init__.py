@@ -1,0 +1,21 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+A from-scratch rebuild of the capabilities of ziqi-zhang/FedML (a fork of
+FedML-AI/FedML) designed for TPU hardware: federated rounds are pure jitted
+functions over sharded client state, local SGD runs as `lax.scan`, clients are
+parallelised with `vmap` (single chip) or `shard_map` over a `jax.sharding.Mesh`
+(multi chip), and aggregation is a weighted `psum` over ICI instead of MPI
+point-to-point of pickled state_dicts.
+
+Layer map (mirrors reference README.md:119-140 4-layer design):
+  L4  fedml_tpu.experiments — CLI mains / run configs
+  L3  fedml_tpu.algorithms / models / data — algorithm zoo, model zoo, data pipeline
+  L2  fedml_tpu.core — kernel contracts (ModelTrainer, RoundState, config,
+      topology, robust aggregation, non-IID partition)
+  L1  jax/XLA — collectives over ICI/DCN replace mpi4py/paho-mqtt transport
+"""
+
+__version__ = "0.1.0"
+
+from fedml_tpu.core.config import FedConfig  # noqa: F401
+from fedml_tpu.core.trainer import ModelTrainer  # noqa: F401

@@ -1,0 +1,3 @@
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+__all__ = ["FedAvgAPI"]

@@ -1,0 +1,173 @@
+"""Server aggregation rules — one interface, the whole zoo plugs in.
+
+An aggregator is a callable
+    (global_variables, LocalResult, weights, rng, state) -> (new_global, state)
+where LocalResult.variables is a client-stacked pytree (leading axis C).
+
+  FedAvgAggregator   <- reference FedAVGAggregator.py:58-87 (weighted mean)
+  FedOptAggregator   <- reference FedOptAggregator.py:94-123 (server optimizer
+                        on the pseudo-gradient w_global - w_avg; OptRepo
+                        name->optimizer mapping becomes optax lookup)
+  RobustAggregator   <- reference fedml_core/robustness/robust_aggregation.py:32-55
+                        (per-client delta norm clipping + weak-DP gaussian noise)
+  FedNovaAggregator  <- reference standalone/fednova/fednova.py:79-155
+                        (normalized averaging with tau_eff)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.pytree import (
+    tree_sub,
+    tree_add,
+    tree_scale,
+    tree_weighted_mean,
+)
+
+
+class FedAvgAggregator:
+    """Sample-weighted mean over every variable collection (the reference
+    averages the full state_dict, BN stats included)."""
+
+    def __init__(self, cfg: FedConfig):
+        self.cfg = cfg
+
+    def init_state(self, global_variables) -> Any:
+        return ()
+
+    def __call__(self, global_variables, result, weights, rng, state):
+        return tree_weighted_mean(result.variables, weights), state
+
+
+def make_server_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+    """Reference OptRepo (fedopt/optrepo.py:7-64) maps a name to any torch
+    optimizer class by reflection; here the registry is explicit optax."""
+    name = cfg.server_optimizer.lower()
+    if name == "sgd":
+        return optax.sgd(cfg.server_lr, momentum=cfg.server_momentum or None)
+    if name == "adam":
+        return optax.adam(cfg.server_lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name == "yogi":
+        return optax.yogi(cfg.server_lr)
+    if name == "adagrad":
+        return optax.adagrad(cfg.server_lr)
+    raise ValueError(f"unknown server_optimizer {cfg.server_optimizer!r}")
+
+
+class FedOptAggregator:
+    """FedOpt family: treat (w_global - w_avg) as a pseudo-gradient and step a
+    server optimizer (FedAdam / FedYogi / server-SGD-with-momentum).
+
+    With server sgd lr=1.0 this reduces exactly to FedAvg — a property test
+    exploits that (reference set_model_global_grads FedOptAggregator.py:109).
+    Non-param collections (BN stats) are plainly averaged.
+    """
+
+    def __init__(self, cfg: FedConfig):
+        self.cfg = cfg
+        self.opt = make_server_optimizer(cfg)
+
+    def init_state(self, global_variables):
+        return self.opt.init(global_variables["params"])
+
+    def __call__(self, global_variables, result, weights, rng, opt_state):
+        avg = tree_weighted_mean(result.variables, weights)
+        pseudo_grad = tree_sub(global_variables["params"], avg["params"])
+        updates, opt_state = self.opt.update(pseudo_grad, opt_state, global_variables["params"])
+        new_params = optax.apply_updates(global_variables["params"], updates)
+        new_global = dict(avg)
+        new_global["params"] = new_params
+        return new_global, opt_state
+
+
+class RobustAggregator:
+    """Norm-clip each client's delta to `norm_bound`, weighted-average, then
+    add N(0, stddev^2) weak-DP noise to weight leaves (reference
+    robust_aggregation.py:37-55; `is_weight_param` at :28 skips BN
+    running stats / num_batches_tracked — here: skips non-"params"
+    collections, which is where flax keeps them)."""
+
+    def __init__(self, cfg: FedConfig):
+        self.cfg = cfg
+
+    def init_state(self, global_variables):
+        return ()
+
+    def __call__(self, global_variables, result, weights, rng, state):
+        gp = global_variables["params"]
+
+        def clip_one(client_params):
+            delta = tree_sub(client_params, gp)
+            nrm = jnp.sqrt(
+                sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(delta)) + 1e-12
+            )
+            scale = jnp.minimum(1.0, self.cfg.norm_bound / nrm)
+            return tree_add(gp, tree_scale(delta, scale))
+
+        clipped = jax.vmap(clip_one)(result.variables["params"])
+        stacked = dict(result.variables)
+        stacked["params"] = clipped
+        avg = tree_weighted_mean(stacked, weights)
+
+        noise_rng = jax.random.fold_in(rng, 7)
+        leaves, treedef = jax.tree.flatten(avg["params"])
+        keys = jax.random.split(noise_rng, len(leaves))
+        noisy = [
+            l + self.cfg.stddev * jax.random.normal(k, l.shape, l.dtype)
+            for l, k in zip(leaves, keys)
+        ]
+        avg["params"] = jax.tree.unflatten(treedef, noisy)
+        return avg, state
+
+
+class FedNovaAggregator:
+    """FedNova normalized averaging (Wang et al. 2020; reference
+    fednova.py:79-155): client deltas are normalized by their local step
+    count tau_i, then recombined with effective tau
+    tau_eff = sum_i w_i * tau_i so that objective inconsistency from
+    heterogeneous local work is removed.
+
+    d_i = (w_global - w_i) / tau_i ;  w_new = w_global - tau_eff * sum_i w_i d_i
+    """
+
+    def __init__(self, cfg: FedConfig):
+        self.cfg = cfg
+
+    def init_state(self, global_variables):
+        return ()
+
+    def __call__(self, global_variables, result, weights, rng, state):
+        gp = global_variables["params"]
+        w = weights / jnp.sum(weights)
+        tau = jnp.maximum(result.num_steps.astype(jnp.float32), 1.0)
+        tau_eff = jnp.sum(w * tau)
+
+        def combine(leaf_stack, g):
+            # leaf_stack: [C, ...] client params; normalized delta average
+            d = (g[None] - leaf_stack) / tau.reshape((-1,) + (1,) * (leaf_stack.ndim - 1))
+            wavg = jnp.sum(d * w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype), axis=0)
+            return g - tau_eff * wavg
+
+        new_params = jax.tree.map(combine, result.variables["params"], gp)
+        avg = tree_weighted_mean(result.variables, weights)
+        new_global = dict(avg)
+        new_global["params"] = new_params
+        return new_global, state
+
+
+AGGREGATORS = {
+    "fedavg": FedAvgAggregator,
+    "fedopt": FedOptAggregator,
+    "robust": RobustAggregator,
+    "fednova": FedNovaAggregator,
+}
+
+
+def make_aggregator(name: str, cfg: FedConfig):
+    return AGGREGATORS[name](cfg)

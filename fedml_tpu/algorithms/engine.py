@@ -1,0 +1,201 @@
+"""The federated round engine — local SGD + aggregation as one jitted function.
+
+This replaces the reference's message-driven actor loop (SURVEY §3.1/§3.2):
+where the reference runs one MPI process per worker and ships pickled
+state_dicts, here a round is a pure function
+
+    round_fn(global_variables, agg_state, x, y, counts, rng)
+        -> (new_global, agg_state, train_metrics)
+
+with clients vectorized by `vmap` (single chip) — and by `shard_map` over a
+device mesh in fedml_tpu.parallel (aggregation then lowers to a weighted
+`psum` over ICI).
+
+Local-SGD parity notes (reference my_model_trainer_classification.py:17-53):
+torch DataLoader(shuffle=True, drop_last=False) epoch semantics are reproduced
+inside jit by sorting a uniform draw restricted to the valid prefix —
+`argsort(where(valid, u, +inf))` yields a permutation of the real samples
+followed by padding, so batches are full except the last, which is masked.
+Steps on all-padding batches are made no-ops via `tree_where` so Adam/momentum
+state is not polluted (SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.pytree import tree_where
+
+
+class LocalResult(NamedTuple):
+    variables: Any  # per-client trained variables (stacked under vmap)
+    num_steps: jnp.ndarray  # actual optimizer steps taken (FedNova tau)
+    metrics: dict  # summed train metrics of the final epoch
+
+
+def make_local_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+    """Client optimizer matching reference trainer construction
+    (my_model_trainer_classification.py:25-31: SGD(lr) or Adam(lr, wd,
+    amsgrad=True)), with optional grad clipping (:46, clip at 1.0)."""
+    chain = []
+    if cfg.grad_clip is not None:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+    if cfg.client_optimizer == "sgd":
+        chain.append(optax.sgd(cfg.lr, momentum=cfg.momentum or None))
+        if cfg.wd:
+            chain.insert(-1, optax.add_decayed_weights(cfg.wd))
+    elif cfg.client_optimizer == "adam":
+        # torch Adam(weight_decay=wd, amsgrad=True): L2 added to the gradient
+        # *before* adaptive scaling (not adamw-style decoupled decay)
+        if cfg.wd:
+            chain.append(optax.add_decayed_weights(cfg.wd))
+        chain.append(optax.amsgrad(cfg.lr))
+    else:
+        raise ValueError(f"unknown client_optimizer {cfg.client_optimizer!r}")
+    return optax.chain(*chain)
+
+
+def _merge_variables(variables, new_params, new_state):
+    out = dict(variables)
+    out["params"] = new_params
+    for k, v in new_state.items():
+        out[k] = v
+    return out
+
+
+def build_local_update(trainer, cfg: FedConfig) -> Callable:
+    """Returns local_update(global_variables, x, y, count, rng) -> LocalResult.
+
+    x: [n_max, ...], y: [n_max, ...], count: scalar int. Runs cfg.epochs of
+    minibatch SGD (lax.scan over epochs and batches).
+    """
+    if cfg.epochs < 1:
+        raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
+    opt = make_local_optimizer(cfg)
+    mu = cfg.fedprox_mu
+
+    def local_update(global_variables, x, y, count, rng) -> LocalResult:
+        n_max = x.shape[0]
+        b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
+        nb = math.ceil(n_max / b)
+        n_pad = nb * b
+        global_params = global_variables["params"]
+        opt_state = opt.init(global_params)
+
+        def epoch_body(carry, erng):
+            variables, opt_state, steps = carry
+            shuffle_rng, step_rng = jax.random.split(erng)
+            u = jax.random.uniform(shuffle_rng, (n_max,))
+            valid = jnp.arange(n_max) < count
+            perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+            if n_pad > n_max:
+                perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
+            batch_idx = perm.reshape(nb, b)
+            batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
+
+            def step_body(carry, scan_in):
+                variables, opt_state, steps = carry
+                idx, bvalid, srng = scan_in
+                batch = {
+                    "x": jnp.take(x, idx, axis=0),
+                    "y": jnp.take(y, idx, axis=0),
+                    "mask": bvalid.astype(jnp.float32),
+                }
+
+                def loss_wrap(params):
+                    vars_in = _merge_variables(variables, params, {})
+                    loss, (new_state, aux) = trainer.loss_fn(vars_in, batch, srng, True)
+                    if mu > 0.0:
+                        # FedProx proximal term mu/2 * ||w - w_global||^2
+                        # (reference fednova.py:124-126 applies it in-optimizer)
+                        sq = sum(
+                            jnp.sum(jnp.square(p - g))
+                            for p, g in zip(jax.tree.leaves(params), jax.tree.leaves(global_params))
+                        )
+                        loss = loss + 0.5 * mu * sq
+                    return loss, (new_state, aux)
+
+                grad_fn = jax.value_and_grad(loss_wrap, has_aux=True)
+                (_, (new_state, aux)), grads = grad_fn(variables["params"])
+                updates, new_opt_state = opt.update(grads, opt_state, variables["params"])
+                new_params = optax.apply_updates(variables["params"], updates)
+                has_data = jnp.any(bvalid)
+                new_vars = _merge_variables(variables, new_params, new_state)
+                variables = tree_where(has_data, new_vars, variables)
+                opt_state = tree_where(has_data, new_opt_state, opt_state)
+                steps = steps + has_data.astype(jnp.int32)
+                return (variables, opt_state, steps), aux
+
+            srngs = jax.random.split(step_rng, nb)
+            (variables, opt_state, steps), auxs = jax.lax.scan(
+                step_body, (variables, opt_state, steps), (batch_idx, batch_valid, srngs)
+            )
+            return (variables, opt_state, steps), auxs
+
+        erngs = jax.random.split(rng, cfg.epochs)
+        (variables, opt_state, steps), auxs = jax.lax.scan(
+            epoch_body, (global_variables, opt_state, jnp.int32(0)), erngs
+        )
+        # summed train metrics from the final local epoch (shape [E, nb] -> last epoch)
+        metrics = {k: v[-1].sum() for k, v in auxs.items()}
+        return LocalResult(variables, steps, metrics)
+
+    return local_update
+
+
+def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
+    """Jitted synchronous round: vmap(local_update) + aggregate.
+
+    Mirrors the server loop at reference FedAvgServerManager.py:43-88
+    (receive all -> aggregate -> broadcast) collapsed into one XLA program.
+    """
+    local_update = build_local_update(trainer, cfg)
+
+    def round_fn(global_variables, agg_state, x, y, counts, rng):
+        crngs = jax.random.split(rng, x.shape[0])
+        result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+            global_variables, x, y, counts, crngs
+        )
+        new_global, agg_state = aggregator(
+            global_variables, result, counts.astype(jnp.float32), rng, agg_state
+        )
+        # per-client metric sums -> federation totals
+        metrics = {k: v.sum() for k, v in result.metrics.items()}
+        return new_global, agg_state, metrics
+
+    return jax.jit(round_fn)
+
+
+def build_eval_fn(trainer) -> Callable:
+    """Jitted eval over pre-packed [nb, b, ...] batches; returns metric sums."""
+
+    def eval_fn(variables, bx, by, bmask):
+        def body(_, batch):
+            bx_i, by_i, bm_i = batch
+            m = trainer.eval_fn(variables, {"x": bx_i, "y": by_i, "mask": bm_i})
+            return None, m
+        _, ms = jax.lax.scan(body, None, (bx, by, bmask))
+        return {k: v.sum() for k, v in ms.items()}
+
+    return jax.jit(eval_fn)
+
+
+def build_client_eval_fn(trainer) -> Callable:
+    """Per-client eval: vmap over packed client rows [C, n_max, ...]; returns
+    per-client metric sums (reference _local_test_on_all_clients,
+    fedavg_api.py:119-183)."""
+
+    def one(variables, x, y, count):
+        mask = (jnp.arange(x.shape[0]) < count).astype(jnp.float32)
+        return trainer.eval_fn(variables, {"x": x, "y": y, "mask": mask})
+
+    def eval_fn(variables, x, y, counts):
+        return jax.vmap(one, in_axes=(None, 0, 0, 0))(variables, x, y, counts)
+
+    return jax.jit(eval_fn)

@@ -1,0 +1,19 @@
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ModelTrainer
+from fedml_tpu.core.partition import (
+    homo_partition,
+    hetero_partition,
+    p_hetero_partition,
+    non_iid_partition_with_dirichlet_distribution,
+    record_net_data_stats,
+)
+
+__all__ = [
+    "FedConfig",
+    "ModelTrainer",
+    "homo_partition",
+    "hetero_partition",
+    "p_hetero_partition",
+    "non_iid_partition_with_dirichlet_distribution",
+    "record_net_data_stats",
+]

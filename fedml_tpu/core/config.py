@@ -1,0 +1,81 @@
+"""Typed run configuration — replaces the reference's argparse-globals.
+
+The reference passes a raw argparse `args` namespace through every layer
+(reference fedml_experiments/distributed/fedavg/main_fedavg.py:46-112); here the
+same knob surface is a frozen dataclass so it can be closed over by jitted
+functions (all fields are static Python values, never traced).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class FedConfig:
+    """Knobs shared by every algorithm; mirrors reference `add_args`.
+
+    Field names follow reference main_fedavg.py:46-112 so experiment configs
+    transfer verbatim.
+    """
+
+    # data
+    dataset: str = "mnist"
+    data_dir: str = "./data"
+    partition_method: str = "hetero"  # homo | hetero (LDA) | p-hetero | hetero-fix
+    partition_alpha: float = 0.5
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+
+    # model
+    model: str = "lr"
+
+    # local training (reference my_model_trainer_classification.py:17-53)
+    batch_size: int = 10  # -1 = full batch (the CI equivalence-oracle mode)
+    client_optimizer: str = "sgd"  # sgd | adam
+    lr: float = 0.03
+    momentum: float = 0.0
+    wd: float = 0.0
+    epochs: int = 1  # local epochs E
+    # reference my_model_trainer_classification.py:44 clips unconditionally at
+    # 1.0 every step ("to avoid nan loss") — same default here; None disables
+    grad_clip: float | None = 1.0
+
+    # federated loop
+    comm_round: int = 10
+    frequency_of_the_test: int = 1
+
+    # server optimizer (FedOpt; reference main_fedopt.py:54-60)
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.0
+
+    # FedProx / FedNova
+    fedprox_mu: float = 0.0
+
+    # robust aggregation (reference robust_aggregation.py:32-55)
+    norm_bound: float = 5.0
+    stddev: float = 0.025
+
+    # systems
+    seed: int = 0
+    ci: int = 0  # CI mode: eval a single client (reference FedAVGAggregator.py:126-131)
+    backend: str = "vmap"  # vmap (single chip) | shard_map (mesh)
+    mesh_shape: tuple[int, ...] = ()
+    dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models
+
+    extra: dict[str, Any] = field(default_factory=dict, hash=False, compare=False)
+
+    def replace(self, **kw) -> "FedConfig":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FedConfig":
+        names = {f.name for f in dataclasses.fields(cls)}
+        known = {k: v for k, v in d.items() if k in names}
+        extra = {k: v for k, v in d.items() if k not in names}
+        if extra:
+            known.setdefault("extra", {}).update(extra)
+        return cls(**known)

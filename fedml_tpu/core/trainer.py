@@ -1,0 +1,173 @@
+"""ModelTrainer — the framework-agnostic trainer operator, TPU-native form.
+
+Reference contract: fedml_core/trainer/model_trainer.py:4-38 — an ABC with
+get/set params, train, test; "does not cache any states". Here the same idea
+becomes a bundle of *pure functions* over a flax variables pytree, so the whole
+federated round (local SGD included) can live inside one jit:
+
+  - ``init(rng, example_input)``      -> variables pytree
+  - ``loss_fn(variables, batch, rng, train)`` -> (loss, (new_model_state, aux))
+  - ``eval_fn(variables, batch)``     -> dict of metric *sums* (mergeable)
+
+A ``batch`` is a dict with keys ``x``, ``y`` and a float ``mask`` of per-sample
+validity (padding support — SURVEY §7 hard part (a)).
+
+Concrete trainers mirror the reference's three standalone trainers:
+  ClassificationTrainer  <- my_model_trainer_classification.py:10-86
+  NWPTrainer             <- my_model_trainer_nwp.py:10 (ignore_index=0)
+  TagPredictionTrainer   <- my_model_trainer_tag_prediction.py (multi-label)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _module_apply(module, variables, x, rng, train: bool):
+    """Apply a flax module, handling dropout rngs and mutable batch stats.
+
+    All fedml_tpu zoo modules take ``train: bool`` as a keyword. Returns
+    (output, new_model_state) where new_model_state holds updated non-param
+    collections (e.g. BatchNorm running stats) or {} if none.
+    """
+    mutable = [k for k in variables if k != "params"] if train else []
+    rngs = {"dropout": rng} if rng is not None else None
+    if mutable:
+        out, new_state = module.apply(
+            variables, x, train=train, rngs=rngs, mutable=mutable
+        )
+        return out, dict(new_state)
+    out = module.apply(variables, x, train=train, rngs=rngs)
+    return out, {}
+
+
+class ModelTrainer:
+    """Base trainer: wraps a flax module + a task loss into pure functions."""
+
+    def __init__(self, module, id: int = 0):
+        self.module = module
+        self.id = id
+
+    # --- parity shims with reference ModelTrainer ---------------------------
+    def set_id(self, trainer_id: int):
+        self.id = trainer_id
+
+    def get_model_params(self, variables):
+        return variables
+
+    def set_model_params(self, variables, new_params):
+        return new_params
+
+    # --- pure functional surface -------------------------------------------
+    def init(self, rng, example_input):
+        return self.module.init({"params": rng, "dropout": rng}, example_input, train=False)
+
+    def apply(self, variables, x, rng=None, train: bool = False):
+        return _module_apply(self.module, variables, x, rng, train)
+
+    def loss_fn(self, variables, batch, rng, train: bool = True):
+        raise NotImplementedError
+
+    def eval_fn(self, variables, batch):
+        raise NotImplementedError
+
+
+class ClassificationTrainer(ModelTrainer):
+    """Cross-entropy classification (reference my_model_trainer_classification.py).
+
+    Loss is the masked mean of per-sample CE over the batch — identical to
+    torch's ``CrossEntropyLoss()`` mean reduction on the valid samples.
+    """
+
+    def loss_fn(self, variables, batch, rng, train: bool = True):
+        logits, new_state = self.apply(variables, batch["x"], rng, train)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        mask = batch["mask"].astype(per.dtype)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per * mask).sum() / denom
+        correct = ((jnp.argmax(logits, -1) == batch["y"]) * mask).sum()
+        aux = {"loss_sum": (per * mask).sum(), "correct": correct, "total": mask.sum()}
+        return loss, (new_state, aux)
+
+    def eval_fn(self, variables, batch):
+        logits, _ = self.apply(variables, batch["x"], None, train=False)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        mask = batch["mask"].astype(per.dtype)
+        correct = ((jnp.argmax(logits, -1) == batch["y"]) * mask).sum()
+        return {
+            "test_correct": correct,
+            "test_loss": (per * mask).sum(),
+            "test_total": mask.sum(),
+        }
+
+
+class NWPTrainer(ModelTrainer):
+    """Next-word prediction with pad-id masking (reference
+    my_model_trainer_nwp.py: CE with ignore_index=0, accuracy over non-pad).
+
+    Batch ``y`` has shape [b, seq]; logits [b, seq, vocab]. Tokens equal to
+    ``pad_id`` are ignored in both loss and accuracy, in addition to the
+    per-sample padding mask.
+    """
+
+    def __init__(self, module, pad_id: int = 0, id: int = 0):
+        super().__init__(module, id)
+        self.pad_id = pad_id
+
+    def _masked_ce(self, variables, batch, rng, train):
+        logits, new_state = self.apply(variables, batch["x"], rng, train)
+        y = batch["y"]
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        tok_mask = (y != self.pad_id).astype(per.dtype)
+        samp_mask = batch["mask"].astype(per.dtype)
+        mask = tok_mask * samp_mask[:, None]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per * mask).sum() / denom
+        correct = ((jnp.argmax(logits, -1) == y) * mask).sum()
+        return loss, new_state, {"loss_sum": (per * mask).sum(), "correct": correct, "total": mask.sum()}
+
+    def loss_fn(self, variables, batch, rng, train: bool = True):
+        loss, new_state, aux = self._masked_ce(variables, batch, rng, train)
+        return loss, (new_state, aux)
+
+    def eval_fn(self, variables, batch):
+        _, _, aux = self._masked_ce(variables, batch, None, False)
+        return {
+            "test_correct": aux["correct"],
+            "test_loss": aux["loss_sum"],
+            "test_total": aux["total"],
+        }
+
+
+class TagPredictionTrainer(ModelTrainer):
+    """Multi-label tag prediction (reference my_model_trainer_tag_prediction.py):
+    BCE-with-logits loss; precision/recall sums at threshold 0.5."""
+
+    def loss_fn(self, variables, batch, rng, train: bool = True):
+        logits, new_state = self.apply(variables, batch["x"], rng, train)
+        y = batch["y"].astype(logits.dtype)  # [b, num_tags] multi-hot
+        per = optax.sigmoid_binary_cross_entropy(logits, y).mean(axis=-1)
+        mask = batch["mask"].astype(per.dtype)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per * mask).sum() / denom
+        aux = {"loss_sum": (per * mask).sum(), "total": mask.sum()}
+        return loss, (new_state, aux)
+
+    def eval_fn(self, variables, batch):
+        logits, _ = self.apply(variables, batch["x"], None, train=False)
+        y = batch["y"].astype(logits.dtype)
+        pred = (jax.nn.sigmoid(logits) > 0.5).astype(logits.dtype)
+        mask = batch["mask"].astype(logits.dtype)[:, None]
+        per = optax.sigmoid_binary_cross_entropy(logits, y).mean(axis=-1)
+        tp = (pred * y * mask).sum()
+        return {
+            "test_loss": (per * batch["mask"].astype(per.dtype)).sum(),
+            "test_tp": tp,
+            "test_pred_pos": (pred * mask).sum(),
+            "test_true_pos": (y * mask).sum(),
+            "test_total": batch["mask"].astype(per.dtype).sum(),
+        }

@@ -1,0 +1,11 @@
+from fedml_tpu.data.packing import PackedClients, pack_client_data, pack_eval_batches
+from fedml_tpu.data.registry import FederatedDataset, load_dataset, register_loader
+
+__all__ = [
+    "PackedClients",
+    "pack_client_data",
+    "pack_eval_batches",
+    "FederatedDataset",
+    "load_dataset",
+    "register_loader",
+]

@@ -1,0 +1,96 @@
+"""Dataset registry + the reference's uniform 9-tuple loader contract.
+
+Every reference loader returns
+  (client_num, train_data_num, test_data_num, train_data_global,
+   test_data_global, train_data_local_num_dict, train_data_local_dict,
+   test_data_local_dict, class_num)
+(reference MNIST/data_loader.py:127-173, consumed at
+main_fedavg.py:115-221). Here the native object is `FederatedDataset`
+holding fixed-shape `PackedClients`; `as_nine_tuple()` reproduces the
+reference contract for API compatibility.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from fedml_tpu.data.packing import PackedClients
+
+
+@dataclass
+class FederatedDataset:
+    name: str
+    train: PackedClients
+    test: PackedClients | None  # per-client test split (None => global only)
+    train_global: tuple[np.ndarray, np.ndarray]
+    test_global: tuple[np.ndarray, np.ndarray]
+    class_num: int
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def client_num(self) -> int:
+        return self.train.num_clients
+
+    @property
+    def train_data_num(self) -> int:
+        return self.train.total_samples
+
+    @property
+    def test_data_num(self) -> int:
+        return int(self.test_global[0].shape[0])
+
+    def as_nine_tuple(self):
+        """Reference-compatible 9-tuple (dict-of-arrays in place of DataLoaders)."""
+        train_local = {
+            i: (self.train.x[i][: self.train.counts[i]], self.train.y[i][: self.train.counts[i]])
+            for i in range(self.client_num)
+        }
+        if self.test is not None:
+            test_local = {
+                i: (self.test.x[i][: self.test.counts[i]], self.test.y[i][: self.test.counts[i]])
+                for i in range(self.client_num)
+            }
+        else:
+            test_local = {i: self.test_global for i in range(self.client_num)}
+        return (
+            self.client_num,
+            self.train_data_num,
+            self.test_data_num,
+            self.train_global,
+            self.test_global,
+            {i: int(self.train.counts[i]) for i in range(self.client_num)},
+            train_local,
+            test_local,
+            self.class_num,
+        )
+
+
+_LOADERS: dict[str, Callable] = {}
+
+
+def register_loader(name: str):
+    def deco(fn):
+        _LOADERS[name] = fn
+        return fn
+
+    return deco
+
+
+def load_dataset(name: str, **kwargs) -> FederatedDataset:
+    """Load a federated dataset by name (mirrors reference `load_data` dispatch,
+    main_fedavg.py:115-221)."""
+    # import for side-effect registration
+    import fedml_tpu.data.loaders  # noqa: F401
+
+    if name not in _LOADERS:
+        raise KeyError(f"unknown dataset {name!r}; known: {sorted(_LOADERS)}")
+    return _LOADERS[name](**kwargs)
+
+
+def available_datasets():
+    import fedml_tpu.data.loaders  # noqa: F401
+
+    return sorted(_LOADERS)

@@ -1,0 +1,168 @@
+"""Raw data sources: read real files when available, else deterministic
+surrogates with the reference datasets' shapes and class structure.
+
+The reference downloads via torchvision / TFF h5 / LEAF json
+(reference data/README.md:1-28). This environment has no network egress, so
+each `load_*_arrays` checks `data_dir` for the real artifacts first (npz, IDX,
+HDF5) and falls back to a seeded synthetic surrogate of the same shape —
+loaders, partitioners, packing and training are identical either way.
+"""
+
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import struct
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX (MNIST-format) file, gzipped or raw."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32, 13: np.float32, 14: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))
+        return data.reshape(dims)
+
+
+def _find(data_dir: str, names: list[str]) -> str | None:
+    for name in names:
+        for root in (data_dir, os.path.join(data_dir, "MNIST", "raw"), os.path.join(data_dir, "raw")):
+            p = os.path.join(root, name)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def synthetic_image_classes(
+    n: int,
+    class_num: int,
+    shape: tuple[int, ...],
+    seed: int,
+    noise: float = 0.35,
+    proto_seed: int | None = None,
+):
+    """Seeded surrogate image dataset: each class is a random prototype +
+    gaussian noise, so linear/CNN models show real learning curves (loss falls,
+    accuracy >> chance) and equivalence oracles are meaningful.
+
+    `proto_seed` fixes the class prototypes independently of the sample draw so
+    train and test splits come from the same distribution."""
+    proto_rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
+    protos = proto_rng.normal(0.0, 1.0, size=(class_num,) + shape).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, class_num, size=n).astype(np.int32)
+    x = protos[y] * 0.6 + rng.normal(0.0, noise, size=(n,) + shape).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def load_mnist_arrays(data_dir: str = "./data", flatten: bool = False, seed: int = 0):
+    """(x_train, y_train, x_test, y_test) normalized like torchvision MNIST
+    (mean 0.1307, std 0.3081 — reference MNIST/data_loader.py transforms)."""
+    tr_img = _find(data_dir, ["train-images-idx3-ubyte.gz", "train-images-idx3-ubyte"])
+    tr_lab = _find(data_dir, ["train-labels-idx1-ubyte.gz", "train-labels-idx1-ubyte"])
+    te_img = _find(data_dir, ["t10k-images-idx3-ubyte.gz", "t10k-images-idx3-ubyte"])
+    te_lab = _find(data_dir, ["t10k-labels-idx1-ubyte.gz", "t10k-labels-idx1-ubyte"])
+    if all(p is not None for p in (tr_img, tr_lab, te_img, te_lab)):
+        xtr = _read_idx(tr_img).astype(np.float32) / 255.0
+        xte = _read_idx(te_img).astype(np.float32) / 255.0
+        xtr = (xtr - 0.1307) / 0.3081
+        xte = (xte - 0.1307) / 0.3081
+        ytr = _read_idx(tr_lab).astype(np.int32)
+        yte = _read_idx(te_lab).astype(np.int32)
+        xtr = xtr[..., None]
+        xte = xte[..., None]
+    else:
+        log.warning("MNIST files not found under %s — using seeded surrogate", data_dir)
+        xtr, ytr = synthetic_image_classes(6000, 10, (28, 28, 1), seed, proto_seed=seed + 9999)
+        xte, yte = synthetic_image_classes(1000, 10, (28, 28, 1), seed + 1, proto_seed=seed + 9999)
+    if flatten:
+        xtr = xtr.reshape(len(xtr), -1)
+        xte = xte.reshape(len(xte), -1)
+    return xtr, ytr, xte, yte
+
+
+def load_femnist_arrays(data_dir: str = "./data", client_num: int = 3400, seed: int = 0):
+    """FederatedEMNIST: per-writer natural split, 62 classes, 28x28
+    (reference FederatedEMNIST/data_loader.py:16-77, TFF h5 export).
+
+    Returns (xs, ys) lists of per-client arrays [n_i, 28, 28, 1] / [n_i].
+    Reads the TFF `fed_emnist_train.h5`/`fed_emnist_test.h5` if present.
+    """
+    try:
+        import h5py  # noqa: F401
+
+        have_h5py = True
+    except Exception:
+        have_h5py = False
+    train_h5 = os.path.join(data_dir, "fed_emnist_train.h5")
+    test_h5 = os.path.join(data_dir, "fed_emnist_test.h5")
+    if have_h5py and os.path.exists(train_h5) and os.path.exists(test_h5):
+        import h5py
+
+        def read(path):
+            xs, ys = [], []
+            with h5py.File(path, "r") as f:
+                examples = f["examples"]
+                for cid in sorted(examples.keys()):
+                    g = examples[cid]
+                    xs.append(np.asarray(g["pixels"], dtype=np.float32)[..., None])
+                    ys.append(np.asarray(g["label"], dtype=np.int32))
+            return xs, ys
+
+        xtr, ytr = read(train_h5)
+        xte, yte = read(test_h5)
+        return xtr, ytr, xte, yte
+
+    log.warning("FEMNIST h5 not found under %s — using seeded surrogate", data_dir)
+    rng = np.random.RandomState(seed)
+    protos = rng.normal(0.0, 1.0, size=(62, 28, 28, 1)).astype(np.float32)
+    xtr, ytr, xte, yte = [], [], [], []
+    for _ in range(client_num):
+        # natural splits are unbalanced: lognormal-ish sizes around the TFF
+        # per-writer mean (~227 train / ~26 test samples)
+        n_i = int(np.clip(rng.lognormal(4.6, 0.45), 16, 480))
+        t_i = max(2, n_i // 9)
+        y_i = rng.randint(0, 62, size=n_i + t_i).astype(np.int32)
+        x_i = protos[y_i] * 0.6 + rng.normal(0, 0.35, size=(n_i + t_i, 28, 28, 1)).astype(np.float32)
+        xtr.append(x_i[:n_i].astype(np.float32))
+        ytr.append(y_i[:n_i])
+        xte.append(x_i[n_i:].astype(np.float32))
+        yte.append(y_i[n_i:])
+    return xtr, ytr, xte, yte
+
+
+def fedprox_synthetic(
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    client_num: int = 30,
+    dim: int = 60,
+    class_num: int = 10,
+    seed: int = 0,
+):
+    """The FedProx synthetic(alpha, beta) generator (reference
+    data_preprocessing/synthetic_1_1 — samples per-client softmax-regression
+    tasks: W_k ~ N(u_k, 1), u_k ~ N(0, alpha); x_k ~ N(v_k, Sigma),
+    v_k ~ N(B_k, 1), B_k ~ N(0, beta); sizes ~ lognormal)."""
+    rng = np.random.RandomState(seed)
+    sizes = (rng.lognormal(4, 2, client_num).astype(int) + 50).clip(50, 2000)
+    sigma = np.diag(np.arange(1, dim + 1) ** -1.2)
+    xs, ys = [], []
+    for k in range(client_num):
+        u_k = rng.normal(0, alpha)
+        b_k = rng.normal(0, beta)
+        w = rng.normal(u_k, 1, size=(dim, class_num))
+        b = rng.normal(u_k, 1, size=class_num)
+        v_k = rng.normal(b_k, 1, size=dim)
+        x = rng.multivariate_normal(v_k, sigma, size=int(sizes[k])).astype(np.float32)
+        logits = x @ w + b
+        y = np.argmax(logits, axis=1).astype(np.int32)
+        xs.append(x)
+        ys.append(y)
+    return xs, ys

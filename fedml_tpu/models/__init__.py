@@ -1,0 +1,3 @@
+from fedml_tpu.models.registry import create_model, register_model, available_models
+
+__all__ = ["create_model", "register_model", "available_models"]

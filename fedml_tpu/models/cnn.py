@@ -1,0 +1,65 @@
+"""FedAvg-paper CNNs (reference fedml_api/model/cv/cnn.py), flax/NHWC.
+
+  CNN_OriginalFedAvg  <- cnn.py:8  (McMahan et al. 2016; 1,663,370 params with
+                         only_digits=True — verified by tests)
+  CNN_DropOut         <- cnn.py:77 (Reddi et al. "Adaptive Federated
+                         Optimization" EMNIST CNN; 1,199,882 params digits)
+  CNNCifar            <- cnn.py:243 (small CIFAR CNN)
+
+Inputs are NHWC [b, 28, 28, 1] / [b, 32, 32, 3] — the TPU-native layout
+(channels-last feeds the MXU without transposes).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class CNN_OriginalFedAvg(nn.Module):
+    """2x(5x5 conv SAME + 2x2 maxpool) -> 512 dense -> out."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME", name="conv2d_1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", name="conv2d_2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, name="linear_1")(x))
+        return nn.Dense(self.output_dim, name="linear_2")(x)
+
+
+class CNN_DropOut(nn.Module):
+    """3x3 VALID convs 32/64 -> maxpool -> drop .25 -> 128 dense -> drop .5 -> out.
+
+    The flagship cross-device model (FEMNIST 84.9% target, BASELINE.md)."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv2d_1")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2d_2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, name="linear_1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.output_dim, name="linear_2")(x)
+
+
+class CNNCifar(nn.Module):
+    """Small CIFAR CNN (reference cnn.py:243): conv6/16 5x5 + pools, fc 120/84."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.max_pool(nn.relu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x)), (2, 2), strides=(2, 2))
+        x = nn.max_pool(nn.relu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x)), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, name="fc2")(x))
+        return nn.Dense(self.output_dim, name="fc3")(x)

@@ -1,0 +1,31 @@
+"""Model registry — mirrors reference `create_model` dispatch
+(reference fedml_experiments/distributed/fedavg/main_fedavg.py:224-260)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_MODELS: dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _MODELS[name] = fn
+        return fn
+
+    return deco
+
+
+def create_model(model_name: str, output_dim: int, **kwargs):
+    """Build a flax module by reference model name (lr, cnn, resnet56, ...)."""
+    import fedml_tpu.models.zoo  # noqa: F401  (side-effect registration)
+
+    if model_name not in _MODELS:
+        raise KeyError(f"unknown model {model_name!r}; known: {sorted(_MODELS)}")
+    return _MODELS[model_name](output_dim=output_dim, **kwargs)
+
+
+def available_models():
+    import fedml_tpu.models.zoo  # noqa: F401
+
+    return sorted(_MODELS)

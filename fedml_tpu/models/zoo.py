@@ -1,0 +1,33 @@
+"""Name -> module registration (reference create_model, main_fedavg.py:224-260)."""
+
+from __future__ import annotations
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.linear import LogisticRegression, DenseMLP
+from fedml_tpu.models.cnn import CNN_OriginalFedAvg, CNN_DropOut, CNNCifar
+
+
+@register_model("lr")
+def _lr(output_dim, **kw):
+    return LogisticRegression(output_dim=output_dim, flatten=kw.get("flatten", True))
+
+
+@register_model("mlp")
+def _mlp(output_dim, **kw):
+    return DenseMLP(output_dim=output_dim, hidden=tuple(kw.get("hidden", (1024, 512, 256, 128))))
+
+
+@register_model("cnn_fedavg")
+def _cnn_fedavg(output_dim, **kw):
+    return CNN_OriginalFedAvg(output_dim=output_dim)
+
+
+@register_model("cnn")
+def _cnn(output_dim, **kw):
+    # reference "cnn" for femnist = CNN_DropOut (main_fedavg.py:233-236)
+    return CNN_DropOut(output_dim=output_dim)
+
+
+@register_model("cnn_cifar")
+def _cnn_cifar(output_dim, **kw):
+    return CNNCifar(output_dim=output_dim)

@@ -1,0 +1,23 @@
+from fedml_tpu.utils.pytree import (
+    tree_weighted_mean,
+    tree_mean,
+    tree_where,
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_global_norm,
+    tree_zeros_like,
+    tree_cast,
+)
+
+__all__ = [
+    "tree_weighted_mean",
+    "tree_mean",
+    "tree_where",
+    "tree_add",
+    "tree_sub",
+    "tree_scale",
+    "tree_global_norm",
+    "tree_zeros_like",
+    "tree_cast",
+]

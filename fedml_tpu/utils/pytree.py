@@ -1,0 +1,69 @@
+"""Pytree arithmetic used by every aggregator.
+
+The reference aggregates PyTorch state_dicts with a per-key Python loop on the
+server CPU (reference FedAVGAggregator.py:58-87 — the scaling bottleneck noted
+in SURVEY §3.1). Here model parameters are JAX pytrees and aggregation is a
+handful of fused XLA ops; under `shard_map` the same weighted mean lowers to a
+`psum` over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_weighted_mean(stacked_tree, weights):
+    """Weighted mean over the leading (client) axis of a stacked pytree.
+
+    `stacked_tree` leaves have shape [C, ...]; `weights` is [C] (unnormalized,
+    e.g. per-client sample counts — reference FedAVGAggregator.py:72-80 uses
+    `local_sample_number / training_num`).
+    """
+    w = weights / jnp.sum(weights)
+
+    def avg(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+        return jnp.sum(leaf * wb, axis=0)
+
+    return jax.tree.map(avg, stacked_tree)
+
+
+def tree_mean(stacked_tree):
+    return jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked_tree)
+
+
+def tree_where(pred, a, b):
+    """Select pytree `a` where scalar bool `pred` else `b` (no branching)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a, s):
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_cast(a, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), a)
+
+
+def tree_global_norm(a):
+    """L2 norm over all leaves (reference robust_aggregation.py vectorize+norm)."""
+    leaves = jax.tree.leaves(a)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def tree_size(a) -> int:
+    """Total number of scalars in the pytree."""
+    return sum(int(l.size) for l in jax.tree.leaves(a))

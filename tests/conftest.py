@@ -1,0 +1,12 @@
+"""Test config: force an 8-device virtual CPU mesh before jax import.
+
+Multi-chip sharding logic (shard_map over a clients mesh axis) is exercised on
+virtual CPU devices exactly as the driver's dryrun does.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
