@@ -1,0 +1,93 @@
+"""Benchmark: FedAvg rounds/sec + samples/sec/chip on the flagship workload.
+
+Workload mirrors the reference's FEMNIST north star (BASELINE.md: 3400
+clients, 10 clients/round, CNN_DropOut, bs 20, E=1, SGD lr 0.1 — reference
+benchmark/README.md:56-59) with FEMNIST-shaped data (~200 samples/client).
+The reference publishes no throughput numbers (BASELINE.json "published": {}),
+so vs_baseline is null unless a reference measurement is provided via
+BENCH_REF_SAMPLES_PER_SEC_PER_CHIP.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    clients_per_round = int(os.environ.get("BENCH_CLIENTS_PER_ROUND", 10))
+    n_per_client = int(os.environ.get("BENCH_SAMPLES_PER_CLIENT", 200))
+    epochs = int(os.environ.get("BENCH_EPOCHS", 1))
+    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 20))
+    timed_rounds = int(os.environ.get("BENCH_ROUNDS", 20))
+
+    cfg = FedConfig(
+        batch_size=batch_size, epochs=epochs, lr=0.1, client_optimizer="sgd",
+        client_num_per_round=clients_per_round,
+    )
+    trainer = ClassificationTrainer(create_model("cnn", output_dim=62))
+    agg = make_aggregator("fedavg", cfg)
+    n_chips = jax.device_count()
+    if n_chips > 1:
+        # shard the round's clients over every chip (ICI aggregation)
+        from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
+
+        clients_per_round = ((clients_per_round + n_chips - 1) // n_chips) * n_chips
+        round_fn = build_sharded_round_fn(trainer, cfg, agg, make_mesh())
+    else:
+        round_fn = build_round_fn(trainer, cfg, agg)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(clients_per_round, n_per_client, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 62, size=(clients_per_round, n_per_client)).astype(np.int32))
+    counts = jnp.asarray(np.full(clients_per_round, n_per_client, np.int32))
+
+    key = jax.random.PRNGKey(0)
+    gv = trainer.init(key, x[0, :1])
+    state = agg.init_state(gv)
+
+    # warmup (compile)
+    gv, state, _ = round_fn(gv, state, x, y, counts, key)
+    jax.block_until_ready(gv)
+
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        gv, state, _ = round_fn(gv, state, x, y, counts, jax.random.fold_in(key, r))
+    jax.block_until_ready(gv)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = timed_rounds / dt
+    samples_per_round = clients_per_round * n_per_client * epochs
+    samples_per_sec_per_chip = rounds_per_sec * samples_per_round / n_chips
+
+    ref = os.environ.get("BENCH_REF_SAMPLES_PER_SEC_PER_CHIP")
+    vs_baseline = samples_per_sec_per_chip / float(ref) if ref else None
+
+    print(json.dumps({
+        "metric": "fedavg_femnist_cnn_samples_per_sec_per_chip",
+        "value": round(samples_per_sec_per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": vs_baseline,
+        "rounds_per_sec": round(rounds_per_sec, 4),
+        "clients_per_round": clients_per_round,
+        "samples_per_client": n_per_client,
+        "batch_size": batch_size,
+        "n_chips": n_chips,
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ import numpy as np
 from fedml_tpu.algorithms.aggregators import make_aggregator
 from fedml_tpu.algorithms.engine import build_client_eval_fn, build_eval_fn, build_round_fn
 from fedml_tpu.core.config import FedConfig
-from fedml_tpu.data.packing import pack_eval_batches
+from fedml_tpu.data.packing import pack_eval_batches, pad_clients
 from fedml_tpu.data.registry import FederatedDataset
 
 log = logging.getLogger(__name__)
@@ -56,7 +56,19 @@ class FedAvgAPI:
         self.cfg = config
         self.trainer = model_trainer
         self.aggregator = make_aggregator(aggregator_name, config)
-        self.round_fn = build_round_fn(model_trainer, config, self.aggregator)
+        self.mesh = None
+        if config.backend == "shard_map":
+            from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
+
+            # any mesh_shape flattens onto the 1-D clients axis; richer axes
+            # (groups/stages) belong to the hierarchical / splitnn APIs
+            shape = (int(np.prod(config.mesh_shape)),) if config.mesh_shape else None
+            self.mesh = make_mesh(shape, axis_names=("clients",))
+            self.round_fn = build_sharded_round_fn(
+                model_trainer, config, self.aggregator, self.mesh
+            )
+        else:
+            self.round_fn = build_round_fn(model_trainer, config, self.aggregator)
         self.eval_fn = build_eval_fn(model_trainer)
         self.client_eval_fn = build_client_eval_fn(model_trainer)
         self.history: list[dict[str, Any]] = []
@@ -74,6 +86,8 @@ class FedAvgAPI:
         cfg = self.cfg
         idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
         x, y, counts = self.dataset.train.select(idx)
+        if self.mesh is not None:
+            x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
         rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
         self.global_variables, self.agg_state, train_metrics = self.round_fn(
             self.global_variables, self.agg_state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), rng

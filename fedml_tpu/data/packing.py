@@ -84,6 +84,17 @@ def pack_client_lists(xs: list[np.ndarray], ys: list[np.ndarray], n_max: int | N
     return PackedClients(px, py, counts)
 
 
+def pad_clients(x: np.ndarray, y: np.ndarray, counts: np.ndarray, multiple: int):
+    """Pad a round's client batch to a multiple of `multiple` rows with
+    zero-count clients (weight-0 no-ops in every aggregator)."""
+    pad = (-len(counts)) % multiple
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
+    return x, y, counts
+
+
 def pack_eval_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     """Pad a flat eval set to [num_batches, batch_size, ...] + mask for a
     jitted scan over batches."""

@@ -1,0 +1,11 @@
+"""Multi-chip parallelism: device meshes + shard_map federated rounds.
+
+This package is the TPU-native replacement for the reference's entire
+`fedml_core/distributed` transport stack (MPI send/recv threads + pickled
+state_dicts, reference com_manager.py:13-101): the "cluster" is a
+`jax.sharding.Mesh`, clients are sharded over the `clients` axis, and the
+server's weighted average is an XLA collective over ICI.
+"""
+
+from fedml_tpu.parallel.mesh import make_mesh  # noqa: F401
+from fedml_tpu.parallel.sharded import build_sharded_round_fn  # noqa: F401

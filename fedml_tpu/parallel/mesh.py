@@ -1,0 +1,32 @@
+"""Device-mesh construction helpers.
+
+The reference maps MPI ranks to GPUs via a YAML hostfile
+(reference fedml_api/distributed/utils/gpu_mapping.py:8-37). Here placement is
+a `jax.sharding.Mesh`; axis names give the FL-parallelism taxonomy:
+
+  clients — client/data parallelism (one client shard per device group)
+  groups  — hierarchical FL outer axis (cloud -> group -> client)
+  stages  — model-split axis (SplitNN pipeline analog)
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def make_mesh(shape: tuple[int, ...] | None = None, axis_names: tuple[str, ...] = ("clients",)) -> Mesh:
+    """Create a mesh over the available devices.
+
+    With `shape=None`, all devices form a 1-D mesh over `axis_names[0]`.
+    """
+    devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    dev_mesh = mesh_utils.create_device_mesh(shape, devices=devices[:n])
+    return Mesh(dev_mesh, axis_names)

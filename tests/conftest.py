@@ -1,12 +1,22 @@
 """Test config: force an 8-device virtual CPU mesh before jax import.
 
 Multi-chip sharding logic (shard_map over a clients mesh axis) is exercised on
-virtual CPU devices exactly as the driver's dryrun does.
+virtual CPU devices exactly as the driver's dryrun does. The environment may
+pre-set JAX_PLATFORMS to the real TPU tunnel, so we override unconditionally;
+set FEDML_TPU_TESTS_ON_TPU=1 to run the suite on the real chip instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+if not os.environ.get("FEDML_TPU_TESTS_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+    # this environment's sitecustomize pre-imports jax to register the TPU
+    # plugin; the env var alone is then too late, but the backend is not yet
+    # initialized so jax.config can still redirect to the virtual CPU mesh
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,100 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh.
+
+The key property: the shard_map round is bit-equivalent to the single-chip
+vmap round (same per-client RNG table, same client order through tiled
+all_gather, same replicated aggregation) — the TPU mesh is a faithful
+"cluster" for the reference's MPI deployment (SURVEY §3.1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.aggregators import make_aggregator
+from fedml_tpu.algorithms.engine import build_round_fn
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_mesh((8,), ("clients",))
+
+
+@pytest.fixture(scope="module")
+def ds16():
+    return load_dataset("mnist", client_num_in_total=16, partition_method="homo", seed=1)
+
+
+@pytest.mark.parametrize("agg_name", ["fedavg", "fedopt", "fednova"])
+def test_sharded_round_equals_vmap_round(mesh8, ds16, agg_name):
+    cfg = FedConfig(batch_size=8, epochs=2, lr=0.05, client_num_in_total=16,
+                    client_num_per_round=16, server_optimizer="sgd", server_lr=1.0)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds16.class_num))
+    agg = make_aggregator(agg_name, cfg)
+
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.asarray(ds16.train.x[:1, 0]))
+    state = agg.init_state(gv)
+    x, y, counts = ds16.train.select(np.arange(16))
+    x, y, counts = jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts)
+
+    vmap_round = build_round_fn(trainer, cfg, agg)
+    shard_round = build_sharded_round_fn(trainer, cfg, agg, mesh8)
+
+    g1, s1, m1 = vmap_round(gv, state, x, y, counts, rng)
+    g2, s2, m2 = shard_round(gv, state, x, y, counts, rng)
+
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g2)
+    assert max(jax.tree.leaves(d)) < 1e-6
+    for k in m1:
+        assert abs(float(m1[k]) - float(m2[k])) < 1e-3
+
+
+def test_api_shard_map_backend_trains(ds16):
+    cfg = FedConfig(backend="shard_map", comm_round=3, batch_size=16, lr=0.1,
+                    client_num_in_total=16, client_num_per_round=10)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds16.class_num))
+    api = FedAvgAPI(ds16, cfg, trainer)
+    hist = api.train()
+    assert hist[-1]["Test/Acc"] > 0.5
+    # 10 clients padded to 16 shard rows — padding must not corrupt training
+    assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+
+
+def test_zero_count_client_padding_is_noop(mesh8, ds16):
+    """A round padded with zero-count clients equals the unpadded vmap round
+    over the real clients only."""
+    cfg = FedConfig(batch_size=8, epochs=1, lr=0.05,
+                    client_num_in_total=16, client_num_per_round=16)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds16.class_num))
+    agg = make_aggregator("fedavg", cfg)
+    rng = jax.random.PRNGKey(2)
+    gv = trainer.init(rng, jnp.asarray(ds16.train.x[:1, 0]))
+
+    x, y, counts = ds16.train.select(np.arange(6))
+    vmap_round = build_round_fn(trainer, cfg, agg)
+    g_ref, _, _ = vmap_round(gv, (), jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), rng)
+
+    pad = 2
+    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    yp = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+    cp = np.concatenate([counts, np.zeros(pad, counts.dtype)])
+    shard_round = build_sharded_round_fn(trainer, cfg, agg, make_mesh((8,), ("clients",)))
+    g_pad, _, _ = shard_round(gv, (), jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(cp), rng)
+
+    # padded clients draw different RNG keys for the real clients' positions?
+    # no — key table is split(rng, C) either way, but C differs (6 vs 8), so
+    # compare against a vmap run over the padded batch instead for exactness
+    g_ref_pad, _, _ = vmap_round(gv, (), jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(cp), rng)
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref_pad, g_pad)
+    assert max(jax.tree.leaves(d)) < 1e-6
+    # and weight-0 padding must leave the weighted mean unchanged vs 6 clients
+    d2 = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_pad)
+    assert max(jax.tree.leaves(d2)) < 1e-4
