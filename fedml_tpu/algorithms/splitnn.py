@@ -1,0 +1,179 @@
+"""SplitNN — split learning with a client/server model split, TPU-native.
+
+Behavior-parity rebuild of reference fedml_api/distributed/split_nn/
+(client.py:24-35 forward/backward halves, server.py:40-61 upper half + loss,
+manager round-robin relay at client_manager.py:35-67). The reference crosses
+the MPI wire twice per batch (SURVEY §3.3 — the latency pattern to beat);
+here the split model is a *composition* inside one jitted step: the server's
+grad w.r.t. activations is exactly what `jax.grad` computes through the
+composed function, so one XLA program replaces the per-batch ping-pong while
+keeping the two halves' parameters and optimizers separate (semantics
+preserved: per-client lower weights stay local, only the server trunk is
+shared across the round-robin relay).
+
+Multi-chip: the two halves can live on different mesh stages; on one chip XLA
+fuses the composition outright (strictly better than staging for these sizes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.registry import FederatedDataset
+
+
+def make_splitnn_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+    """Reference split_nn uses SGD(lr=0.1, momentum=0.9, wd=5e-4) on both
+    halves (client.py:18-19, server.py:19-20)."""
+    return optax.chain(
+        optax.add_decayed_weights(cfg.wd if cfg.wd else 5e-4),
+        optax.sgd(cfg.lr, momentum=cfg.momentum if cfg.momentum else 0.9),
+    )
+
+
+def build_split_step(client_module, server_module, cfg: FedConfig) -> Callable:
+    """One batch step: client-half forward -> server-half forward + CE loss ->
+    grads through the composition -> separate optimizer updates."""
+    opt = make_splitnn_optimizer(cfg)
+
+    def step(client_params, server_params, c_opt, s_opt, batch):
+        def loss_fn(cp, sp):
+            acts = client_module.apply({"params": cp}, batch["x"], train=True)
+            logits = server_module.apply({"params": sp}, acts, train=True)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+            mask = batch["mask"].astype(per.dtype)
+            loss = (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            correct = ((jnp.argmax(logits, -1) == batch["y"]) * mask).sum()
+            return loss, (correct, mask.sum())
+
+        (loss, (correct, total)), (cg, sg) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(client_params, server_params)
+        cu, c_opt = opt.update(cg, c_opt, client_params)
+        su, s_opt = opt.update(sg, s_opt, server_params)
+        return (
+            optax.apply_updates(client_params, cu),
+            optax.apply_updates(server_params, su),
+            c_opt,
+            s_opt,
+            {"loss": loss, "correct": correct, "total": total},
+        )
+
+    return step
+
+
+class SplitNNAPI:
+    """Round-robin split learning over a client pool (reference SplitNNAPI.py:15).
+
+    Each logical client owns the lower-half weights for its data; the server
+    trunk is shared and trains continuously as the relay token passes
+    client -> client (reference semaphore messages)."""
+
+    def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
+                 client_module, server_module):
+        self.dataset = dataset
+        self.cfg = cfg
+        self.client_module = client_module
+        self.server_module = server_module
+        self.opt = make_splitnn_optimizer(cfg)
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        example = jnp.asarray(dataset.train.x[:1, 0])
+        cvars = client_module.init({"params": rng}, example, train=False)
+        acts = client_module.apply(cvars, example, train=False)
+        svars = server_module.init({"params": jax.random.fold_in(rng, 1)}, acts, train=False)
+
+        n_clients = dataset.client_num
+        # independent lower halves per client (stacked), one shared trunk
+        self.client_params = jax.vmap(
+            lambda k: client_module.init({"params": k}, example, train=False)["params"]
+        )(jax.random.split(rng, n_clients))
+        self.server_params = svars["params"]
+        self.client_opts = jax.vmap(lambda k: self.opt.init(
+            client_module.init({"params": k}, example, train=False)["params"]
+        ))(jax.random.split(rng, n_clients))
+        self.server_opt = self.opt.init(self.server_params)
+
+        step = build_split_step(client_module, server_module, cfg)
+
+        def client_epoch(cp, sp, co, so, x, y, count, rng):
+            n_max = x.shape[0]
+            b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
+            nb = -(-n_max // b)
+            u = jax.random.uniform(rng, (n_max,))
+            valid = jnp.arange(n_max) < count
+            perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+            pad = nb * b - n_max
+            if pad:
+                perm = jnp.concatenate([perm, jnp.zeros(pad, perm.dtype)])
+            bidx = perm.reshape(nb, b)
+            bmask = (jnp.arange(nb * b) < count).reshape(nb, b)
+
+            def body(carry, scan_in):
+                cp, sp, co, so = carry
+                idx, m = scan_in
+                batch = {"x": jnp.take(x, idx, 0), "y": jnp.take(y, idx, 0),
+                         "mask": m.astype(jnp.float32)}
+                cp, sp, co, so, metrics = step(cp, sp, co, so, batch)
+                return (cp, sp, co, so), metrics
+
+            (cp, sp, co, so), ms = jax.lax.scan(body, (cp, sp, co, so), (bidx, bmask))
+            return cp, sp, co, so, {k: v.sum() for k, v in ms.items()}
+
+        self._client_epoch = jax.jit(client_epoch)
+        self.history: list[dict[str, Any]] = []
+
+    def train(self) -> list[dict[str, Any]]:
+        """cfg.comm_round relay cycles; within a cycle every client runs
+        cfg.epochs local epochs against the shared trunk, in turn."""
+        cfg = self.cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        for cycle in range(cfg.comm_round):
+            correct = total = loss = 0.0
+            for k in range(self.dataset.client_num):
+                x, y, counts = self.dataset.train.select(np.array([k]))
+                cp = jax.tree.map(lambda l: l[k], self.client_params)
+                co = jax.tree.map(lambda l: l[k], self.client_opts)
+                for e in range(cfg.epochs):
+                    rng = jax.random.fold_in(key, cycle * 131071 + k * 257 + e)
+                    cp, self.server_params, co, self.server_opt, m = self._client_epoch(
+                        cp, self.server_params, co, self.server_opt,
+                        jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(counts[0]), rng,
+                    )
+                    correct += float(m["correct"]); total += float(m["total"]); loss += float(m["loss"])
+                self.client_params = jax.tree.map(
+                    lambda stack, new: stack.at[k].set(new), self.client_params, cp
+                )
+                self.client_opts = jax.tree.map(
+                    lambda stack, new: stack.at[k].set(new), self.client_opts, co
+                )
+            self.history.append({
+                "round": cycle,
+                "Train/Acc": correct / max(total, 1.0),
+                "Train/Loss": loss / max(self.dataset.client_num * cfg.epochs, 1),
+            })
+        return self.history
+
+    def evaluate(self) -> dict[str, float]:
+        """Global test set through every client's half, sample-weighted."""
+        xte, yte = self.dataset.test_global
+        x = jnp.asarray(xte)
+        y = jnp.asarray(yte)
+        correct = 0.0
+
+        @jax.jit
+        def eval_one(cp, sp):
+            acts = self.client_module.apply({"params": cp}, x, train=False)
+            logits = self.server_module.apply({"params": sp}, acts, train=False)
+            return (jnp.argmax(logits, -1) == y).sum()
+
+        for k in range(self.dataset.client_num):
+            cp = jax.tree.map(lambda l: l[k], self.client_params)
+            correct += float(eval_one(cp, self.server_params))
+        return {"Test/Acc": correct / (len(yte) * self.dataset.client_num)}

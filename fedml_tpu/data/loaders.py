@@ -6,6 +6,8 @@ partitions with fedml_tpu.core.partition and packs fixed-shape client arrays.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from fedml_tpu.core.partition import (
@@ -82,18 +84,7 @@ def load_femnist(
     """FederatedEMNIST natural per-writer split, 62 classes
     (reference FederatedEMNIST/data_loader.py:16-77)."""
     xtr, ytr, xte, yte = sources.load_femnist_arrays(data_dir, client_num=client_num_in_total, seed=seed)
-    train = pack_client_lists(xtr, ytr)
-    test = pack_client_lists(xte, yte)
-    return FederatedDataset(
-        name="femnist",
-        train=train,
-        test=test,
-        train_global=(np.concatenate([a[:c] for a, c in zip(train.x, train.counts)]),
-                      np.concatenate([a[:c] for a, c in zip(train.y, train.counts)])),
-        test_global=(np.concatenate([a[:c] for a, c in zip(test.x, test.counts)]),
-                     np.concatenate([a[:c] for a, c in zip(test.y, test.counts)])),
-        class_num=62,
-    )
+    return _from_client_lists("femnist", xtr, ytr, xte, yte, 62)
 
 
 @register_loader("synthetic")
@@ -123,3 +114,127 @@ def load_synthetic(
         test_global=(np.concatenate(xte), np.concatenate(yte)),
         class_num=class_num,
     )
+
+
+def _from_client_lists(name, xtr, ytr, xte, yte, class_num, **meta):
+    """Build a FederatedDataset from naturally-split per-client arrays."""
+    train = pack_client_lists(xtr, ytr)
+    test = pack_client_lists(xte, yte)
+
+    def flat(packed):
+        return (np.concatenate([a[:c] for a, c in zip(packed.x, packed.counts)]),
+                np.concatenate([a[:c] for a, c in zip(packed.y, packed.counts)]))
+
+    return FederatedDataset(
+        name=name, train=train, test=test,
+        train_global=flat(train), test_global=flat(test),
+        class_num=class_num, meta=meta,
+    )
+
+
+def _register_global_image(name, class_num, source_name=None):
+    """Register a loader over a globally-pooled dataset partitioned by
+    homo / hetero (LDA) / p-hetero (reference cifar10/data_loader.py:284)."""
+
+    @register_loader(name)
+    def _load(data_dir="./data", client_num_in_total=10, partition_method="hetero",
+              partition_alpha=0.5, seed=0, **_):
+        xtr, ytr, xte, yte = sources.load_cifar_arrays(source_name or name, data_dir, seed)
+        return _from_global(name, xtr, ytr, xte, yte, class_num,
+                            client_num_in_total, partition_method, partition_alpha, seed)
+
+    return _load
+
+
+_register_global_image("cifar10", 10)
+_register_global_image("cifar100", 100)
+
+
+@register_loader("cinic10")
+def load_cinic10(data_dir="./data", client_num_in_total=10, partition_method="hetero",
+                 partition_alpha=0.5, seed=0, **_):
+    """CINIC-10 (CIFAR-shaped ImageNet+CIFAR mix, reference cinic10/).
+    Reads `cinic10.npz` (x_train/y_train/x_test/y_test) if present; never
+    substitutes CIFAR-10 files — absent real data means the surrogate."""
+    p = os.path.join(data_dir, "cinic10.npz")
+    if os.path.exists(p):
+        try:
+            d = np.load(p)
+            xtr, ytr = d["x_train"].astype(np.float32), d["y_train"].astype(np.int32)
+            xte, yte = d["x_test"].astype(np.float32), d["y_test"].astype(np.int32)
+        except Exception as e:
+            sources.log.warning("failed reading %s (%s) — using surrogate", p, e)
+            xtr, ytr = sources.synthetic_image_classes(5000, 10, (32, 32, 3), seed, proto_seed=seed + 778)
+            xte, yte = sources.synthetic_image_classes(1000, 10, (32, 32, 3), seed + 1, proto_seed=seed + 778)
+    else:
+        sources.log.warning("cinic10.npz not found under %s — using seeded surrogate", data_dir)
+        xtr, ytr = sources.synthetic_image_classes(5000, 10, (32, 32, 3), seed, proto_seed=seed + 778)
+        xte, yte = sources.synthetic_image_classes(1000, 10, (32, 32, 3), seed + 1, proto_seed=seed + 778)
+    return _from_global("cinic10", xtr, ytr, xte, yte, 10,
+                        client_num_in_total, partition_method, partition_alpha, seed)
+
+
+@register_loader("fmnist")
+def load_fmnist(data_dir="./data", client_num_in_total=10, partition_method="homo",
+                partition_alpha=0.5, seed=0, **_):
+    """Fashion-MNIST (fork MNIST/data_loader.py handles mnist/fmnist/emnist)."""
+    xtr, ytr, xte, yte = sources.load_mnist_arrays(os.path.join(data_dir, "fmnist"), seed=seed + 5)
+    return _from_global("fmnist", xtr, ytr, xte, yte, 10,
+                        client_num_in_total, partition_method, partition_alpha, seed)
+
+
+@register_loader("fed_cifar100")
+def load_fed_cifar100(data_dir="./data", client_num_in_total=500, seed=0, **_):
+    """TFF fed_cifar100 natural split (reference fed_cifar100/data_loader.py)."""
+    xtr, ytr, xte, yte = sources.load_fed_cifar100_clients(data_dir, client_num_in_total, seed)
+    return _from_client_lists("fed_cifar100", xtr, ytr, xte, yte, 100)
+
+
+@register_loader("shakespeare")
+def load_shakespeare(data_dir="./data", client_num_in_total=715, seed=0, **_):
+    """LEAF shakespeare: 80-char window -> next char (classification head,
+    reference shakespeare/data_loader.py:11-50)."""
+    xtr, ytr, xte, yte = sources.load_shakespeare_clients(data_dir, client_num_in_total, seed, per_position=False)
+    return _from_client_lists("shakespeare", xtr, ytr, xte, yte,
+                              sources.SHAKESPEARE_VOCAB, task="next_char")
+
+
+@register_loader("fed_shakespeare")
+def load_fed_shakespeare(data_dir="./data", client_num_in_total=715, seed=0, **_):
+    """TFF fed_shakespeare: per-position next-char targets (NWP-style loss,
+    reference fed_shakespeare/data_loader.py)."""
+    xtr, ytr, xte, yte = sources.load_shakespeare_clients(data_dir, client_num_in_total, seed, per_position=True)
+    return _from_client_lists("fed_shakespeare", xtr, ytr, xte, yte,
+                              sources.SHAKESPEARE_VOCAB, task="nwp")
+
+
+@register_loader("stackoverflow_nwp")
+def load_stackoverflow_nwp(data_dir="./data", client_num_in_total=200, seed=0, **_):
+    xtr, ytr, xte, yte = sources.load_stackoverflow_nwp_clients(data_dir, client_num_in_total, seed)
+    return _from_client_lists("stackoverflow_nwp", xtr, ytr, xte, yte, 10004, task="nwp")
+
+
+@register_loader("stackoverflow_lr")
+def load_stackoverflow_lr(data_dir="./data", client_num_in_total=200, seed=0, **_):
+    xtr, ytr, xte, yte = sources.load_stackoverflow_lr_clients(data_dir, client_num_in_total, seed)
+    return _from_client_lists("stackoverflow_lr", xtr, ytr, xte, yte, 500, task="tag_prediction")
+
+
+def _register_tabular(name, class_num, default_partition="homo"):
+    @register_loader(name)
+    def _load(data_dir="./data", client_num_in_total=10, partition_method=None,
+              partition_alpha=0.5, seed=0, **_):
+        xtr, ytr, xte, yte = sources.load_tabular_arrays(name, data_dir, seed)
+        return _from_global(name, xtr, ytr, xte, yte, class_num, client_num_in_total,
+                            partition_method or default_partition, partition_alpha, seed)
+
+    return _load
+
+
+# fork extras (reference fedml_api/data_preprocessing/{UCIAdult,purchase,texas,
+# UCI_HAR,CHMNIST}; used by privacy_fedml membership-inference experiments)
+_register_tabular("adult", 2)
+_register_tabular("purchase100", 100)
+_register_tabular("texas100", 100)
+_register_tabular("har", 6)
+_register_tabular("chmnist", 8)

@@ -166,3 +166,278 @@ def fedprox_synthetic(
         xs.append(x)
         ys.append(y)
     return xs, ys
+
+
+# ---------------------------------------------------------------------------
+# image datasets beyond MNIST
+
+
+def load_cifar_arrays(name: str = "cifar10", data_dir: str = "./data", seed: int = 0):
+    """CIFAR-10/100 / CINIC-10 arrays, NHWC float32 normalized per reference
+    transforms (cifar10/data_loader.py: mean/std normalize; Cutout is a
+    train-time aug applied by the caller). Falls back to a seeded surrogate
+    of the same shape when the pickled batches are absent."""
+    class_num = 100 if name == "cifar100" else 10
+    loaded = None
+    try:
+        import pickle
+
+        if name == "cifar10":
+            base = os.path.join(data_dir, "cifar-10-batches-py")
+            if os.path.isdir(base):
+                xs, ys = [], []
+                for i in range(1, 6):
+                    with open(os.path.join(base, f"data_batch_{i}"), "rb") as f:
+                        d = pickle.load(f, encoding="bytes")
+                    xs.append(d[b"data"]); ys.append(d[b"labels"])
+                xtr = np.concatenate(xs); ytr = np.concatenate(ys)
+                with open(os.path.join(base, "test_batch"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xte = np.asarray(d[b"data"]); yte = np.asarray(d[b"labels"])
+                loaded = (xtr, ytr, xte, yte)
+        elif name == "cifar100":
+            base = os.path.join(data_dir, "cifar-100-python")
+            if os.path.isdir(base):
+                with open(os.path.join(base, "train"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xtr = np.asarray(d[b"data"]); ytr = np.asarray(d[b"fine_labels"])
+                with open(os.path.join(base, "test"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xte = np.asarray(d[b"data"]); yte = np.asarray(d[b"fine_labels"])
+                loaded = (xtr, ytr, xte, yte)
+    except Exception as e:  # corrupt files -> surrogate
+        log.warning("failed reading %s from %s (%s) — using surrogate", name, data_dir, e)
+    if loaded is not None:
+        xtr, ytr, xte, yte = loaded
+        xtr = xtr.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        xte = xte.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
+        std = np.array([0.247, 0.243, 0.262], np.float32)
+        return ((xtr - mean) / std, ytr.astype(np.int32),
+                (xte - mean) / std, yte.astype(np.int32))
+    log.warning("%s files not found under %s — using seeded surrogate", name, data_dir)
+    xtr, ytr = synthetic_image_classes(5000, class_num, (32, 32, 3), seed, proto_seed=seed + 777)
+    xte, yte = synthetic_image_classes(1000, class_num, (32, 32, 3), seed + 1, proto_seed=seed + 777)
+    return xtr, ytr, xte, yte
+
+
+def load_fed_cifar100_clients(data_dir: str = "./data", client_num: int = 500, seed: int = 0):
+    """fed_cifar100: TFF h5 natural split, 500 clients, 24x24 center-crop
+    (reference fed_cifar100/data_loader.py). Surrogate fallback mirrors the
+    100-samples-per-client structure."""
+    train_h5 = os.path.join(data_dir, "fed_cifar100_train.h5")
+    test_h5 = os.path.join(data_dir, "fed_cifar100_test.h5")
+    try:
+        import h5py
+
+        if os.path.exists(train_h5) and os.path.exists(test_h5):
+            def read(path):
+                xs, ys = [], []
+                with h5py.File(path, "r") as f:
+                    ex = f["examples"]
+                    for cid in sorted(ex.keys()):
+                        g = ex[cid]
+                        img = np.asarray(g["image"], np.float32) / 255.0
+                        img = img[:, 4:28, 4:28, :]  # 32->24 center crop
+                        xs.append(img)
+                        ys.append(np.asarray(g["label"], np.int32))
+                return xs, ys
+
+            xtr, ytr = read(train_h5)
+            xte, yte = read(test_h5)
+            return xtr, ytr, xte, yte
+    except Exception as e:
+        log.warning("failed reading fed_cifar100 (%s) — using surrogate", e)
+    log.warning("fed_cifar100 h5 not found under %s — using seeded surrogate", data_dir)
+    rng = np.random.RandomState(seed)
+    protos = rng.normal(0.0, 1.0, size=(100, 24, 24, 3)).astype(np.float32)
+    xtr, ytr, xte, yte = [], [], [], []
+    for _ in range(client_num):
+        y_i = rng.randint(0, 100, size=120).astype(np.int32)
+        x_i = protos[y_i] * 0.6 + rng.normal(0, 0.35, size=(120, 24, 24, 3)).astype(np.float32)
+        xtr.append(x_i[:100]); ytr.append(y_i[:100])
+        xte.append(x_i[100:]); yte.append(y_i[100:])
+    return xtr, ytr, xte, yte
+
+
+# ---------------------------------------------------------------------------
+# text datasets
+
+
+SHAKESPEARE_VOCAB = 90  # reference shakespeare/language_utils.py ALL_LETTERS
+SHAKESPEARE_SEQ = 80  # McMahan et al (fed_shakespeare/utils.py:15)
+
+
+def _markov_text_clients(client_num, vocab, seq_len, per_client, test_frac, seed,
+                         per_position):
+    """Surrogate language data: a shared seeded 2-gram transition table (so
+    next-token structure is learnable) with per-client start states."""
+    rng = np.random.RandomState(seed)
+    # sparse transition table: each token has 4 likely successors. Stored as
+    # [vocab, 4] successor ids + cumulative probs (a dense [vocab, vocab]
+    # table would be ~800 MB for the stackoverflow vocab)
+    succ = np.stack([rng.choice(vocab, 4, replace=False) for _ in range(vocab)])
+    cum = np.cumsum(rng.dirichlet(np.ones(4) * 2.0, size=vocab), axis=1)
+    xtr, ytr, xte, yte = [], [], [], []
+    for c in range(client_num):
+        n_i = max(4, int(per_client * rng.lognormal(0, 0.4)))
+        toks = np.zeros(n_i + seq_len + 1, np.int32)
+        toks[0] = rng.randint(vocab)
+        draws = rng.rand(len(toks))
+        for i in range(1, len(toks)):
+            t = toks[i - 1]
+            toks[i] = succ[t, np.searchsorted(cum[t], draws[i])]
+        windows = np.lib.stride_tricks.sliding_window_view(toks, seq_len + 1)[:n_i]
+        x = windows[:, :seq_len].astype(np.int32)
+        y = windows[:, 1:].astype(np.int32) if per_position else windows[:, -1].astype(np.int32)
+        k = max(1, int(n_i * (1 - test_frac)))
+        xtr.append(x[:k]); ytr.append(y[:k]); xte.append(x[k:]); yte.append(y[k:])
+    return xtr, ytr, xte, yte
+
+
+def load_shakespeare_clients(data_dir: str = "./data", client_num: int = 715,
+                             seed: int = 0, per_position: bool = False):
+    """LEAF shakespeare (reference shakespeare/data_loader.py:11-50): per-role
+    text, 80-char windows -> next char. Reads LEAF train/test json if present."""
+    import json
+
+    tr_dir = os.path.join(data_dir, "shakespeare", "train")
+    te_dir = os.path.join(data_dir, "shakespeare", "test")
+    if os.path.isdir(tr_dir) and os.path.isdir(te_dir):
+        def read(d):
+            users, data = [], {}
+            for fn in sorted(os.listdir(d)):
+                if not fn.endswith(".json"):
+                    continue
+                with open(os.path.join(d, fn)) as f:
+                    j = json.load(f)
+                users += j["users"]
+                data.update(j["user_data"])
+            return users, data
+
+        def to_ids(s):
+            # reference language_utils letter_to_index over ALL_LETTERS
+            all_letters = "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ[]abcdefghijklmnopqrstuvwxyz}"
+            return np.array([all_letters.find(ch) % SHAKESPEARE_VOCAB for ch in s], np.int32)
+
+        users, tr = read(tr_dir)
+        _, te = read(te_dir)
+        xtr, ytr, xte, yte = [], [], [], []
+        for u in users:
+            for data, xs, ys in ((tr[u], xtr, ytr), (te.get(u, {"x": [], "y": []}), xte, yte)):
+                if data["x"]:
+                    x = np.stack([to_ids(s)[:SHAKESPEARE_SEQ] for s in data["x"]])
+                    nxt = np.array([to_ids(s)[0] for s in data["y"]], np.int32)
+                    if per_position:
+                        # per-position targets: window shifted by one, final
+                        # position's target is the LEAF next-char label
+                        y = np.concatenate([x[:, 1:], nxt[:, None]], axis=1)
+                    else:
+                        y = nxt
+                else:
+                    x = np.zeros((0, SHAKESPEARE_SEQ), np.int32)
+                    y = np.zeros((0, SHAKESPEARE_SEQ) if per_position else (0,), np.int32)
+                xs.append(x); ys.append(y)
+        return xtr, ytr, xte, yte
+    log.warning("shakespeare LEAF json not found under %s — using seeded surrogate", data_dir)
+    return _markov_text_clients(client_num, SHAKESPEARE_VOCAB, SHAKESPEARE_SEQ,
+                                per_client=48, test_frac=0.15, seed=seed,
+                                per_position=per_position)
+
+
+def load_stackoverflow_nwp_clients(data_dir: str = "./data", client_num: int = 200,
+                                   seed: int = 0, vocab_size: int = 10004, seq_len: int = 20):
+    """StackOverflow next-word prediction (reference stackoverflow_nwp/):
+    20-token windows over the extended vocab (10000 + pad/bos/eos/oov).
+
+    Reads the TFF export `stackoverflow_train.h5`/`stackoverflow_test.h5`
+    (examples/<client>/tokens rows of whitespace-joined sentences) when
+    present; tokens are hashed into the non-special vocab range."""
+    train_h5 = os.path.join(data_dir, "stackoverflow_train.h5")
+    test_h5 = os.path.join(data_dir, "stackoverflow_test.h5")
+    try:
+        import h5py
+
+        if os.path.exists(train_h5) and os.path.exists(test_h5):
+            def tok_ids(sentence):
+                words = sentence.decode() if isinstance(sentence, bytes) else str(sentence)
+                # 0=pad,1=bos,2=eos; oov/regular hashed into [4, vocab_size)
+                ids = [1] + [4 + (hash(w) % (vocab_size - 4)) for w in words.split()][: seq_len - 2] + [2]
+                ids = ids + [0] * (seq_len + 1 - len(ids))
+                return np.array(ids[: seq_len + 1], np.int32)
+
+            def read(path, cap):
+                xs, ys = [], []
+                with h5py.File(path, "r") as f:
+                    ex = f["examples"]
+                    for cid in sorted(ex.keys())[:cap]:
+                        rows = np.stack([tok_ids(s) for s in ex[cid]["tokens"][:256]])
+                        xs.append(rows[:, :seq_len])
+                        ys.append(rows[:, 1:])
+                return xs, ys
+
+            xtr, ytr = read(train_h5, client_num)
+            xte, yte = read(test_h5, client_num)
+            return xtr, ytr, xte, yte
+    except Exception as e:
+        log.warning("failed reading stackoverflow h5 (%s) — using surrogate", e)
+    log.warning("stackoverflow h5 not found under %s — using seeded surrogate", data_dir)
+    return _markov_text_clients(client_num, vocab_size, seq_len,
+                                per_client=64, test_frac=0.15, seed=seed,
+                                per_position=True)
+
+
+def load_stackoverflow_lr_clients(data_dir: str = "./data", client_num: int = 200,
+                                  seed: int = 0, vocab_size: int = 10000, tag_num: int = 500):
+    """StackOverflow tag prediction (reference stackoverflow_lr/): x =
+    bag-of-words over the 10k vocab, y = multi-hot over 500 tags. Surrogate
+    couples tags to words through a sparse seeded map so LR can learn."""
+    rng = np.random.RandomState(seed)
+    word_tag = np.zeros((vocab_size, tag_num), np.float32)
+    for t in range(tag_num):
+        word_tag[rng.choice(vocab_size, 20, replace=False), t] = 1.0
+    xtr, ytr, xte, yte = [], [], [], []
+    for c in range(client_num):
+        n_i = max(4, int(40 * rng.lognormal(0, 0.4)))
+        x = (rng.rand(n_i, vocab_size) < 0.002).astype(np.float32)
+        scores = x @ word_tag
+        y = (scores >= np.maximum(1.0, np.partition(scores, -3, axis=1)[:, -3:-2])).astype(np.float32)
+        k = max(1, int(n_i * 0.85))
+        xtr.append(x[:k]); ytr.append(y[:k]); xte.append(x[k:]); yte.append(y[k:])
+    return xtr, ytr, xte, yte
+
+
+# ---------------------------------------------------------------------------
+# fork tabular extras (UCIAdult / purchase100 / texas100 / UCI-HAR / CHMNIST)
+
+
+def load_tabular_arrays(name: str, data_dir: str = "./data", seed: int = 0):
+    """Fork datasets for the privacy/membership-inference experiments
+    (reference fedml_api/data_preprocessing/{UCIAdult,purchase,texas,UCI_HAR,
+    CHMNIST}). npz with x_train/y_train/x_test/y_test is read when present;
+    otherwise a seeded surrogate with the dataset's true dimensionality."""
+    dims = {
+        "adult": ((104,), 2),          # one-hot encoded UCI Adult
+        "purchase100": ((600,), 100),  # acquire-valued-shoppers binary basket
+        "texas100": ((6169,), 100),    # hospital discharge features
+        "har": ((128, 9), 6),          # UCI-HAR 128-step 9-channel windows
+        "chmnist": ((64, 64, 1), 8),   # colorectal-histology MNIST
+    }
+    shape, class_num = dims[name]
+    p = os.path.join(data_dir, f"{name}.npz")
+    if os.path.exists(p):
+        try:
+            d = np.load(p)
+            out = (d["x_train"].astype(np.float32), d["y_train"].astype(np.int32),
+                   d["x_test"].astype(np.float32), d["y_test"].astype(np.int32))
+            if out[0].shape[1:] != shape:
+                raise ValueError(f"{name} features {out[0].shape[1:]} != expected {shape}")
+            return out
+        except Exception as e:
+            log.warning("failed reading %s (%s) — using surrogate", p, e)
+    else:
+        log.warning("%s npz not found under %s — using seeded surrogate", name, data_dir)
+    ntr = 6000 if len(shape) == 1 else 3000
+    xtr, ytr = synthetic_image_classes(ntr, class_num, shape, seed, proto_seed=seed + 31)
+    xte, yte = synthetic_image_classes(ntr // 6, class_num, shape, seed + 1, proto_seed=seed + 31)
+    return xtr, ytr, xte, yte

@@ -50,6 +50,28 @@ class CNN_DropOut(nn.Module):
         return nn.Dense(self.output_dim, name="linear_2")(x)
 
 
+class HAR_CNN(nn.Module):
+    """UCI-HAR 1-D CNN (reference fedml_api/model/linear/har_cnn.py:49-84):
+    two 1-D convs 32ch k3 (VALID), dropout .5, maxpool/2, fc 100 -> classes.
+
+    Input [b, seq, channels] (reference is [b, chan, seq] — NHWC analog here).
+    The reference applies a final Softmax before CrossEntropyLoss (a known
+    quirk); we emit raw logits, the correct formulation."""
+
+    output_dim: int = 6
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3,), padding="VALID", name="conv1")(x))
+        x = nn.relu(nn.Conv(32, (3,), padding="VALID", name="conv2")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(x, (2,), strides=(2,))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(100, name="lin3")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.output_dim, name="lin4")(x)
+
+
 class CNNCifar(nn.Module):
     """Small CIFAR CNN (reference cnn.py:243): conv6/16 5x5 + pools, fc 120/84."""
 

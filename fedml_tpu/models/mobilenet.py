@@ -1,0 +1,45 @@
+"""MobileNet v1, flax/NHWC (reference fedml_api/model/cv/mobilenet.py:60-209).
+
+Depthwise-separable stacks with width multiplier alpha; stem 3x3/1 (CIFAR-size
+inputs), stages 32->64->128->256->512(x5)->1024, gap, fc. Depthwise conv maps
+to `feature_group_count=channels` — XLA lowers it to TPU depthwise kernels.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _DWSep(nn.Module):
+    out_ch: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = x.shape[-1]
+        x = nn.Conv(ch, (3, 3), (self.stride, self.stride), padding=1,
+                    feature_group_count=ch, use_bias=False, name="depthwise")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="dw_bn")(x))
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="pointwise")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="pw_bn")(x))
+        return x
+
+
+class MobileNet(nn.Module):
+    output_dim: int = 100
+    alpha: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(n):
+            return int(n * self.alpha)
+
+        x = nn.Conv(c(32), (3, 3), padding=1, use_bias=False, name="stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="stem_bn")(x))
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+        for i, (ch, s) in enumerate(plan):
+            x = _DWSep(c(ch), s, name=f"dw{i}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, name="fc")(x)

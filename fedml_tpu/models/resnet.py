@@ -1,0 +1,151 @@
+"""CIFAR ResNets, flax/NHWC.
+
+Parity targets (architecture, not code):
+  resnet56 / resnet110   <- reference fedml_api/model/cv/resnet.py:218,241
+                            (Bottleneck, layers [6,6,6]/[12,12,12], 3x3 stem
+                            conv 16, stages 16/32/64, BN, avgpool, fc) —
+                            the cross-silo CIFAR benchmark models (BASELINE.md)
+  resnet20/32/44 (fork)  <- reference fedml_api/model/cv/resnet_cifar.py:164-208
+                            (BasicBlock, stem 16, stages 16/32/64)
+
+TPU notes: channels-last layout; BatchNorm momentum 0.9 == torch momentum 0.1;
+convs are bias-free 3x3/1x1 so the whole residual trunk maps onto fused
+MXU matmul+BN+relu ops.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _Norm(nn.Module):
+    """BatchNorm (default) or GroupNorm with `channels_per_group` semantics
+    (reference resnet_gn.py norm2d: GroupNorm2d(planes, num_channels_per_group))."""
+
+    group_norm: int = 0  # 0 = BatchNorm; >0 = channels per group
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.group_norm > 0:
+            groups = max(1, x.shape[-1] // self.group_norm)
+            return nn.GroupNorm(num_groups=groups)(x)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)(x)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    group_norm: int = 0
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        out = nn.Conv(self.planes, (3, 3), (self.stride, self.stride), padding=1, use_bias=False)(x)
+        out = nn.relu(_Norm(self.group_norm)(out, train))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(out)
+        out = _Norm(self.group_norm)(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            identity = nn.Conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride), use_bias=False)(x)
+            identity = _Norm(self.group_norm)(identity, train)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    group_norm: int = 0
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = nn.relu(_Norm(self.group_norm)(out, train))
+        out = nn.Conv(self.planes, (3, 3), (self.stride, self.stride), padding=1, use_bias=False)(out)
+        out = nn.relu(_Norm(self.group_norm)(out, train))
+        out = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(out)
+        out = _Norm(self.group_norm)(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            identity = nn.Conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride), use_bias=False)(x)
+            identity = _Norm(self.group_norm)(identity, train)
+        return nn.relu(out + identity)
+
+
+class ResNetCifar(nn.Module):
+    """3-stage CIFAR ResNet: stem 3x3 conv 16 -> stages 16/32/64 -> gap -> fc."""
+
+    block: Type[nn.Module]
+    layers: Sequence[int]
+    output_dim: int = 10
+    group_norm: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.relu(_Norm(self.group_norm)(x, train))
+        for stage, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.output_dim, name="fc")(x)
+
+
+class ResNetImageNet(nn.Module):
+    """4-stage ImageNet-style ResNet (reference resnet_gn.py:109-135): 7x7/2
+    stem 64, 3x3/2 maxpool, stages 64/128/256/512. With ``group_norm`` > 0 this
+    is the GN variant used for fed_cifar100 (BN replaced for FL — BASELINE.md
+    ResNet18-GN target 44.7)."""
+
+    block: Type[nn.Module]
+    layers: Sequence[int]
+    output_dim: int = 1000
+    group_norm: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False, name="conv1")(x)
+        x = nn.relu(_Norm(self.group_norm)(x, train))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.layers)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, name="fc")(x)
+
+
+def resnet20(output_dim=10, group_norm=0):
+    return ResNetCifar(block=BasicBlock, layers=(3, 3, 3), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet32(output_dim=10, group_norm=0):
+    return ResNetCifar(block=BasicBlock, layers=(5, 5, 5), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet44(output_dim=10, group_norm=0):
+    return ResNetCifar(block=BasicBlock, layers=(7, 7, 7), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet56(output_dim=10, group_norm=0):
+    return ResNetCifar(block=Bottleneck, layers=(6, 6, 6), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet110(output_dim=10, group_norm=0):
+    return ResNetCifar(block=Bottleneck, layers=(12, 12, 12), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet18(output_dim=1000, group_norm=0):
+    return ResNetImageNet(block=BasicBlock, layers=(2, 2, 2, 2), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet34(output_dim=1000, group_norm=0):
+    return ResNetImageNet(block=BasicBlock, layers=(3, 4, 6, 3), output_dim=output_dim, group_norm=group_norm)
+
+
+def resnet50(output_dim=1000, group_norm=0):
+    return ResNetImageNet(block=Bottleneck, layers=(3, 4, 6, 3), output_dim=output_dim, group_norm=group_norm)

@@ -1,0 +1,51 @@
+"""GKT split ResNets (reference fedml_api/model/cv/resnet56_gkt/):
+a small client edge model that emits (logits, feature_maps) and a large
+server model that consumes the feature maps (resnet_client.py:250 /
+resnet_server.py:220 — client ResNet-8 + server ResNet-55).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import BasicBlock, Bottleneck, _Norm
+
+
+class GKTClientResNet(nn.Module):
+    """Edge model: stem + one 16-channel stage; returns (logits, features).
+    Default num_blocks=1 ~ ResNet-8 client (resnet_client.py)."""
+
+    output_dim: int = 10
+    num_blocks: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.relu(_Norm()(x, train))
+        for _ in range(self.num_blocks):
+            x = BasicBlock(planes=16)(x, train)
+        features = x  # [b, h, w, 16] shipped to the server
+        pooled = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.output_dim, name="fc")(pooled)
+        return logits, features
+
+
+class GKTServerResNet(nn.Module):
+    """Server model on extracted features: remaining 16/32/64 stages
+    (resnet_server.py: ResNet-55 = 56 minus the client's stage)."""
+
+    output_dim: int = 10
+    layers: Sequence[int] = (5, 6, 6)
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        x = features
+        for stage, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = Bottleneck(planes=planes, stride=stride)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, name="fc")(x)

@@ -1,0 +1,52 @@
+"""Federated language models (LSTMs), flax.
+
+  RNN_OriginalFedAvg  <- reference fedml_api/model/nlp/rnn.py:4 — Shakespeare
+                         next-char: embed(vocab 90 -> 8, pad 0), 2-layer LSTM
+                         hidden 256, fc to vocab. `per_position=False` emits
+                         logits for the final position only (LEAF shakespeare);
+                         True emits per-position logits (fed_shakespeare).
+  RNN_StackOverFlow   <- reference rnn.py:39 — StackOverflow NWP: extended
+                         vocab 10004 (pad/bos/eos/oov), embed 96, 1-layer LSTM
+                         670, fc 670->96 -> fc 96->vocab, per-position logits.
+
+LSTMs run as `nn.RNN` (lax.scan over time) — sequence lengths are short (80 /
+20 tokens, SURVEY §2.9) so the recurrence is latency-bound, not MXU-bound.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class RNN_OriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    per_position: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [b, seq] int tokens
+        h = nn.Embed(self.vocab_size, self.embedding_dim, name="embeddings")(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm1")(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm2")(h)
+        if not self.per_position:
+            h = h[:, -1]
+        return nn.Dense(self.vocab_size, name="fc")(h)
+
+
+class RNN_StackOverFlow(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        extended = self.vocab_size + 3 + self.num_oov_buckets
+        h = nn.Embed(extended, self.embedding_size, name="word_embeddings")(x)
+        for i in range(self.num_layers):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size), name=f"lstm{i + 1}")(h)
+        h = nn.Dense(self.embedding_size, name="fc1")(h)
+        return nn.Dense(extended, name="fc2")(h)  # [b, seq, extended_vocab]

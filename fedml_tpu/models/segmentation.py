@@ -1,0 +1,25 @@
+"""Small encoder-decoder segmentation net for the FedSeg path.
+
+The reference fork ships the FedSeg algorithm (fedml_api/distributed/fedseg/)
+without a bundled segmentation model or launcher; this FCN stands in so the
+path is testable end-to-end (conv stride-2 encoder, transpose-conv decoder,
+per-pixel logits)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class SimpleFCN(nn.Module):
+    output_dim: int = 21
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.width
+        x = nn.relu(nn.Conv(w, (3, 3), (2, 2), padding=1, name="enc1")(x))
+        x = nn.relu(nn.Conv(2 * w, (3, 3), (2, 2), padding=1, name="enc2")(x))
+        x = nn.relu(nn.Conv(2 * w, (3, 3), padding=1, name="mid")(x))
+        x = nn.relu(nn.ConvTranspose(w, (3, 3), (2, 2), name="dec1")(x))
+        x = nn.ConvTranspose(self.output_dim, (3, 3), (2, 2), name="dec2")(x)
+        return x  # [b, h, w, classes]

@@ -1,0 +1,29 @@
+"""VGG for CIFAR, flax/NHWC (reference fedml_api/model/cv/vgg.py:6-38:
+conv3x3+BN+ReLU stacks with 'M' maxpools, 512-dim classifier)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+CFG = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    variant: str = "vgg11"
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, v in enumerate(CFG[self.variant]):
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, name=f"conv{i}")(x)
+                x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name=f"bn{i}")(x))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.output_dim, name="classifier")(x)

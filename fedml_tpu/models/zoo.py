@@ -4,7 +4,11 @@ from __future__ import annotations
 
 from fedml_tpu.models.registry import register_model
 from fedml_tpu.models.linear import LogisticRegression, DenseMLP
-from fedml_tpu.models.cnn import CNN_OriginalFedAvg, CNN_DropOut, CNNCifar
+from fedml_tpu.models.cnn import CNN_OriginalFedAvg, CNN_DropOut, CNNCifar, HAR_CNN
+from fedml_tpu.models import resnet as _resnet
+from fedml_tpu.models.mobilenet import MobileNet
+from fedml_tpu.models.rnn import RNN_OriginalFedAvg, RNN_StackOverFlow
+from fedml_tpu.models.vgg import VGG
 
 
 @register_model("lr")
@@ -31,3 +35,50 @@ def _cnn(output_dim, **kw):
 @register_model("cnn_cifar")
 def _cnn_cifar(output_dim, **kw):
     return CNNCifar(output_dim=output_dim)
+
+
+@register_model("har_cnn")
+def _har_cnn(output_dim, **kw):
+    return HAR_CNN(output_dim=output_dim)
+
+
+# CIFAR ResNets (reference resnet.py:218,241 / resnet_cifar.py) ---------------
+for _name in ("resnet20", "resnet32", "resnet44", "resnet56", "resnet110",
+              "resnet18", "resnet34", "resnet50"):
+    def _make(output_dim, _f=getattr(_resnet, _name), **kw):
+        return _f(output_dim=output_dim, group_norm=kw.get("group_norm", 0))
+
+    register_model(_name)(_make)
+
+
+@register_model("resnet18_gn")
+def _resnet18_gn(output_dim, **kw):
+    # fed_cifar100 model: GroupNorm replaces BN for FL (BASELINE.md 44.7 target)
+    return _resnet.resnet18(output_dim=output_dim, group_norm=kw.get("group_norm", 2))
+
+
+@register_model("mobilenet")
+def _mobilenet(output_dim, **kw):
+    return MobileNet(output_dim=output_dim, alpha=kw.get("alpha", 1.0))
+
+
+@register_model("rnn")
+def _rnn(output_dim, **kw):
+    # shakespeare next-char model (reference main_fedavg.py "rnn" -> vocab 90)
+    return RNN_OriginalFedAvg(vocab_size=kw.get("vocab_size", output_dim),
+                              per_position=kw.get("per_position", False))
+
+
+@register_model("rnn_stackoverflow")
+def _rnn_so(output_dim, **kw):
+    return RNN_StackOverFlow(vocab_size=kw.get("vocab_size", 10000))
+
+
+@register_model("vgg11")
+def _vgg11(output_dim, **kw):
+    return VGG(variant="vgg11", output_dim=output_dim)
+
+
+@register_model("vgg16")
+def _vgg16(output_dim, **kw):
+    return VGG(variant="vgg16", output_dim=output_dim)

@@ -1,0 +1,197 @@
+"""Algorithm zoo tests: fedopt/fednova/robust aggregators, hierarchical FL,
+decentralized gossip — including the reference CI equivalence oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.centralized import CentralizedTrainer
+from fedml_tpu.algorithms.decentralized import DecentralizedFLAPI
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.topology import (
+    AsymmetricTopologyManager,
+    FullyConnectedTopologyManager,
+    SymmetricTopologyManager,
+)
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+
+
+@pytest.fixture(scope="module")
+def mnist12():
+    return load_dataset("mnist", client_num_in_total=12, partition_method="homo", seed=3)
+
+
+def _trainer(class_num=10):
+    return ClassificationTrainer(create_model("lr", output_dim=class_num))
+
+
+def _maxdiff(a, b):
+    d = jax.tree.map(lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)
+    return max(jax.tree.leaves(d))
+
+
+# --------------------------------------------------------------- aggregators
+
+def test_fedopt_server_sgd_lr1_equals_fedavg(mnist12):
+    """FedOpt with server SGD lr=1.0 reduces exactly to FedAvg (reference
+    set_model_global_grads semantics, FedOptAggregator.py:109)."""
+    cfg = FedConfig(batch_size=16, epochs=1, lr=0.05, comm_round=2,
+                    client_num_in_total=12, client_num_per_round=12,
+                    server_optimizer="sgd", server_lr=1.0)
+    t = _trainer()
+    a = FedAvgAPI(mnist12, cfg, t, aggregator_name="fedavg")
+    b = FedAvgAPI(mnist12, cfg, t, aggregator_name="fedopt")
+    b.global_variables = jax.tree.map(lambda x: x, a.global_variables)
+    for r in range(2):
+        a.train_one_round(r)
+        b.train_one_round(r)
+    assert _maxdiff(a.global_variables, b.global_variables) < 1e-6
+
+
+def test_fedopt_adam_trains(mnist12):
+    cfg = FedConfig(batch_size=16, epochs=1, lr=0.05, comm_round=4,
+                    client_num_in_total=12, client_num_per_round=6,
+                    server_optimizer="adam", server_lr=0.01)
+    api = FedAvgAPI(mnist12, cfg, _trainer(), aggregator_name="fedopt")
+    hist = api.train()
+    assert hist[-1]["Test/Acc"] > 0.5
+
+
+def test_fednova_equal_steps_close_to_fedavg(mnist12):
+    """With homogeneous local work (same tau on every client) FedNova's
+    normalized average stays close to FedAvg."""
+    cfg = FedConfig(batch_size=-1, epochs=1, lr=0.05, comm_round=1, grad_clip=None,
+                    client_num_in_total=12, client_num_per_round=12)
+    t = _trainer()
+    a = FedAvgAPI(mnist12, cfg, t, aggregator_name="fedavg")
+    b = FedAvgAPI(mnist12, cfg, t, aggregator_name="fednova")
+    b.global_variables = jax.tree.map(lambda x: x, a.global_variables)
+    a.train_one_round(0)
+    b.train_one_round(0)
+    assert _maxdiff(a.global_variables, b.global_variables) < 1e-4
+
+
+def test_robust_aggregation_bounds_poisoned_update(mnist12):
+    """A hugely-scaled malicious client delta is norm-clipped (reference
+    robust_aggregation.py:37-47): the robust global stays near the reference
+    global while plain FedAvg is dragged away."""
+    from fedml_tpu.algorithms.aggregators import RobustAggregator, FedAvgAggregator
+    from fedml_tpu.algorithms.engine import LocalResult
+    from fedml_tpu.utils.pytree import tree_global_norm, tree_sub
+
+    cfg = FedConfig(norm_bound=1.0, stddev=0.0)
+    t = _trainer()
+    gv = t.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+
+    def clone_scaled(scale):
+        return jax.tree.map(lambda x: x + scale, gv)
+
+    stacked = jax.tree.map(
+        lambda *ls: jnp.stack(ls), *[clone_scaled(0.01) for _ in range(3)] + [clone_scaled(100.0)]
+    )
+    result = LocalResult(stacked, jnp.ones(4, jnp.int32), {})
+    w = jnp.ones(4)
+    robust, _ = RobustAggregator(cfg)(gv, result, w, jax.random.PRNGKey(1), ())
+    plain, _ = FedAvgAggregator(cfg)(gv, result, w, jax.random.PRNGKey(1), ())
+    drift_robust = float(tree_global_norm(tree_sub(robust["params"], gv["params"])))
+    drift_plain = float(tree_global_norm(tree_sub(plain["params"], gv["params"])))
+    assert drift_plain > 20.0
+    assert drift_robust < 1.0  # each client delta clipped to norm <= 1
+
+
+# -------------------------------------------------------------- hierarchical
+
+def test_hierarchical_oracle_equals_flat_fedavg(mnist12):
+    """CI oracle (reference CI-script-fedavg.sh:52-62): with full-batch E=1,
+    hierarchical FL with G groups x K inner rounds equals flat FedAvg run
+    G*K... — here the strict form: 1 group, K=1 == flat FedAvg exactly."""
+    cfg = FedConfig(batch_size=-1, epochs=1, lr=0.05, comm_round=2, grad_clip=None,
+                    client_num_in_total=12, client_num_per_round=12)
+    t = _trainer()
+    flat = FedAvgAPI(mnist12, cfg, t)
+    hier = HierarchicalFLAPI(mnist12, cfg, t, group_num=1, group_comm_round=1,
+                             group_assignment=[np.arange(12)])
+    hier.global_variables = jax.tree.map(lambda x: x, flat.global_variables)
+    for r in range(2):
+        flat.train_one_round(r)
+        hier.train_one_round(r)
+    assert _maxdiff(flat.global_variables, hier.global_variables) < 1e-5
+
+
+def test_hierarchical_fullbatch_equals_centralized(mnist12):
+    """Full-batch homo: 3 groups x 1 inner round == centralized GD to 1e-3
+    (gradient linearity across the two averaging levels)."""
+    cfg = FedConfig(batch_size=-1, epochs=1, lr=0.05, comm_round=3, grad_clip=None,
+                    client_num_in_total=12, client_num_per_round=12)
+    t = _trainer()
+    hier = HierarchicalFLAPI(mnist12, cfg, t, group_num=3, group_comm_round=1)
+    cen = CentralizedTrainer(mnist12, cfg, t)
+    cen.global_variables = jax.tree.map(lambda x: x, hier.global_variables)
+    for r in range(3):
+        hier.train_one_round(r)
+    cen.train(3)
+    ha = hier.eval_global()
+    ca = cen.eval_global()
+    assert abs(ha["Test/Acc"] - ca["Test/Acc"]) < 2e-3
+    assert abs(ha["Test/Loss"] - ca["Test/Loss"]) < 2e-3
+
+
+def test_hierarchical_learns(mnist12):
+    cfg = FedConfig(batch_size=32, epochs=1, lr=0.1, comm_round=4,
+                    client_num_in_total=12, client_num_per_round=12)
+    api = HierarchicalFLAPI(mnist12, cfg, _trainer(), group_num=3, group_comm_round=2)
+    hist = api.train()
+    assert hist[-1]["Test/Acc"] > 0.5
+
+
+# ------------------------------------------------------------- decentralized
+
+def _streaming_data(n_nodes=8, T=30, dim=12, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.normal(size=(dim, 2)).astype(np.float32)
+    x = rng.normal(size=(n_nodes, T, dim)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n_nodes, T, 2)), axis=-1).astype(np.int32)
+    return x, y
+
+
+def test_topology_matrices_row_stochastic():
+    for mgr in (SymmetricTopologyManager(8, 4),
+                AsymmetricTopologyManager(8, 3, 3, np.random.RandomState(0)),
+                FullyConnectedTopologyManager(8)):
+        mgr.generate_topology()
+        W = mgr.mixing_matrix()
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(8), atol=1e-6)
+        assert all(W[i, i] > 0 for i in range(8))
+    m = SymmetricTopologyManager(6, 2)
+    m.generate_topology()
+    assert m.get_in_neighbor_idx_list(1) == [0, 2]  # pure ring neighbors
+
+
+def test_dsgd_consensus_and_learning():
+    x, y = _streaming_data()
+    cfg = FedConfig(lr=0.1, seed=0)
+    topo = SymmetricTopologyManager(8, 4)
+    api = DecentralizedFLAPI(_trainer(2), cfg, topo)
+    z = api.run(x, y)
+    first5 = np.mean(api.loss_history[:5])
+    last5 = np.mean(api.loss_history[-5:])
+    assert last5 < first5  # online learning reduces loss
+    # gossip drives nodes toward consensus
+    p = z["params"]["linear"]["kernel"]
+    spread = float(jnp.max(jnp.std(p, axis=0)))
+    assert spread < 0.05
+
+
+def test_pushsum_on_directed_topology():
+    x, y = _streaming_data(seed=1)
+    cfg = FedConfig(lr=0.1, seed=0)
+    topo = AsymmetricTopologyManager(8, 3, 3, np.random.RandomState(1))
+    api = DecentralizedFLAPI(_trainer(2), cfg, topo, push_sum=True)
+    api.run(x, y)
+    assert np.isfinite(api.regret())
+    assert np.mean(api.loss_history[-5:]) < np.mean(api.loss_history[:5])

@@ -1,0 +1,80 @@
+"""Model zoo shape/param checks (reference model/cv/test_cnn.py analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.registry import available_models, create_model
+from fedml_tpu.utils.pytree import tree_size
+
+
+def _init_and_apply(module, x):
+    rng = jax.random.PRNGKey(0)
+    variables = module.init({"params": rng, "dropout": rng}, x, train=False)
+    out = module.apply(variables, x, train=False)
+    return variables, out
+
+
+def test_cnn_original_fedavg_param_count():
+    # McMahan CNN: 1,663,370 params with 10 classes (SURVEY §2.5)
+    m = create_model("cnn_fedavg", output_dim=10)
+    v, out = _init_and_apply(m, jnp.zeros((2, 28, 28, 1)))
+    assert tree_size(v["params"]) == 1_663_370
+    assert out.shape == (2, 10)
+
+
+def test_cnn_dropout_param_count():
+    # Reddi et al. FEMNIST CNN: 1,199,882 params with 10 classes
+    m = create_model("cnn", output_dim=10)
+    v, out = _init_and_apply(m, jnp.zeros((2, 28, 28, 1)))
+    assert tree_size(v["params"]) == 1_199_882
+    assert out.shape == (2, 10)
+
+
+@pytest.mark.parametrize("name,inp,out_dim", [
+    ("resnet20", (2, 32, 32, 3), 10),
+    ("resnet56", (2, 32, 32, 3), 10),
+    ("mobilenet", (2, 32, 32, 3), 100),
+    ("vgg11", (2, 32, 32, 3), 10),
+    ("har_cnn", (2, 128, 9), 6),
+])
+def test_cv_models_forward(name, inp, out_dim):
+    m = create_model(name, output_dim=out_dim)
+    v, out = _init_and_apply(m, jnp.zeros(inp))
+    assert out.shape == (2, out_dim)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_resnet18_gn_has_no_batch_stats():
+    m = create_model("resnet18_gn", output_dim=100)
+    v, out = _init_and_apply(m, jnp.zeros((2, 24, 24, 3)))
+    assert "batch_stats" not in v  # GroupNorm everywhere — FL-safe averaging
+    assert out.shape == (2, 100)
+
+
+def test_resnet56_has_batch_stats():
+    m = create_model("resnet56", output_dim=10)
+    v, _ = _init_and_apply(m, jnp.zeros((2, 32, 32, 3)))
+    assert "batch_stats" in v  # BN running stats are averaged like the reference
+
+
+def test_rnn_shakespeare_shapes():
+    m = create_model("rnn", output_dim=90)
+    x = jnp.zeros((4, 80), jnp.int32)
+    v, out = _init_and_apply(m, x)
+    assert out.shape == (4, 90)  # final-position next-char logits
+
+
+def test_rnn_stackoverflow_shapes():
+    m = create_model("rnn_stackoverflow", output_dim=10004)
+    x = jnp.zeros((4, 20), jnp.int32)
+    v, out = _init_and_apply(m, x)
+    assert out.shape == (4, 20, 10004)  # per-position NWP logits
+
+
+def test_registry_lists_models():
+    names = available_models()
+    for required in ("lr", "cnn", "resnet56", "resnet18_gn", "mobilenet", "rnn",
+                     "rnn_stackoverflow", "vgg11", "mlp", "har_cnn"):
+        assert required in names

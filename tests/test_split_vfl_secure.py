@@ -1,0 +1,194 @@
+"""SplitNN, vertical FL, and TurboAggregate secure-sum tests."""
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.registry import load_dataset
+
+
+class LowerHalf(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(32)(x))
+
+
+class UpperHalf(nn.Module):
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim)(nn.relu(nn.Dense(32)(x)))
+
+
+def test_splitnn_trains_roundrobin():
+    from fedml_tpu.algorithms.splitnn import SplitNNAPI
+
+    ds = load_dataset("mnist", client_num_in_total=4, partition_method="homo", seed=0)
+    cfg = FedConfig(comm_round=2, epochs=1, batch_size=32, lr=0.05,
+                    client_num_in_total=4, client_num_per_round=4)
+    api = SplitNNAPI(ds, cfg, LowerHalf(), UpperHalf(output_dim=ds.class_num))
+    hist = api.train()
+    assert hist[-1]["Train/Acc"] > hist[0]["Train/Acc"] or hist[-1]["Train/Acc"] > 0.8
+    assert api.evaluate()["Test/Acc"] > 0.5
+
+
+def test_vfl_two_party_learns():
+    from fedml_tpu.algorithms.vfl import VerticalFederatedLearningAPI
+
+    rng = np.random.RandomState(0)
+    n, d = 600, 20
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int32)
+    splits = [np.arange(0, 8), np.arange(8, 14), np.arange(14, 20)]  # guest + 2 hosts
+    api = VerticalFederatedLearningAPI(splits, lr=0.5)
+    api.fit(X, y, epochs=20, batch_size=64)
+    assert api.score(X, y) > 0.9
+    assert api.loss_history[-1] < api.loss_history[0]
+
+
+def test_vfl_equals_centralized_logistic():
+    """Feature-split training of a linear model == centralized logistic
+    regression (the sum of party components is one linear map)."""
+    from fedml_tpu.algorithms.vfl import VerticalFederatedLearningAPI
+
+    rng = np.random.RandomState(1)
+    n, d = 200, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - X[:, 3] > 0).astype(np.int32)
+
+    two = VerticalFederatedLearningAPI([np.arange(5), np.arange(5, 10)], lr=0.2, seed=7)
+    one = VerticalFederatedLearningAPI([np.arange(10)], lr=0.2, seed=7)
+    # same init: build the single-party weight from the two-party init
+    import jax.numpy as jnp
+    one.params[0]["w"] = jnp.concatenate([two.params[0]["w"], two.params[1]["w"]])
+    one.params[0]["b"] = two.params[0]["b"]
+    two.fit(X, y, epochs=5, batch_size=50, seed=3)
+    one.fit(X, y, epochs=5, batch_size=50, seed=3)
+    np.testing.assert_allclose(two.predict_proba(X), one.predict_proba(X), atol=1e-5)
+
+
+# ------------------------------------------------------------------ secure MPC
+
+def test_bgw_share_and_reconstruct():
+    from fedml_tpu.algorithms.turboaggregate import bgw_encoding, bgw_decoding, DEFAULT_PRIME
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 1000, size=(4, 6)).astype(np.int64)
+    shares = bgw_encoding(X, N=7, T=3, p=DEFAULT_PRIME, rng=rng)
+    rec = bgw_decoding(shares[:4], [0, 1, 2, 3], DEFAULT_PRIME)
+    np.testing.assert_array_equal(rec[0], X)
+
+
+def test_bgw_additivity():
+    """sum of shares decodes to sum of secrets — the property TurboAggregate
+    aggregation relies on."""
+    from fedml_tpu.algorithms.turboaggregate import bgw_encoding, bgw_decoding, DEFAULT_PRIME
+
+    rng = np.random.RandomState(1)
+    A = rng.randint(0, 1000, size=(3, 4)).astype(np.int64)
+    B = rng.randint(0, 1000, size=(3, 4)).astype(np.int64)
+    sa = bgw_encoding(A, 5, 2, rng=rng)
+    sb = bgw_encoding(B, 5, 2, rng=rng)
+    s = np.mod(sa + sb, DEFAULT_PRIME)
+    rec = bgw_decoding(s[:3], [0, 1, 2])
+    np.testing.assert_array_equal(rec[0], A + B)
+
+
+def test_lcc_encode_decode():
+    from fedml_tpu.algorithms.turboaggregate import lcc_encoding, lcc_decoding, DEFAULT_PRIME
+
+    rng = np.random.RandomState(2)
+    X = rng.randint(0, 1000, size=(8, 5)).astype(np.int64)
+    K, T, N = 2, 1, 7
+    enc = lcc_encoding(X, N, K, T, rng=rng)
+    alpha_s = np.arange(-(N // 2), -(N // 2) + N, dtype=np.int64)
+    dec = lcc_decoding(enc[: K + T + 1], alpha_s[: K + T + 1], K, T)
+    np.testing.assert_array_equal(dec.reshape(8, 5), X)
+
+
+def test_secure_aggregator_matches_plain_weighted_mean():
+    from fedml_tpu.algorithms.turboaggregate import SecureAggregator
+    import jax.numpy as jnp
+    from fedml_tpu.utils.pytree import tree_weighted_mean
+    import jax
+
+    rng = np.random.RandomState(3)
+    trees = [{"w": jnp.asarray(rng.normal(size=(5, 3)).astype(np.float32)),
+              "b": jnp.asarray(rng.normal(size=(3,)).astype(np.float32))}
+             for _ in range(4)]
+    weights = np.array([1.0, 2.0, 3.0, 4.0])
+    agg = SecureAggregator(num_clients=4, threshold=2, seed=0)
+    secure = agg.secure_weighted_sum(trees, weights)
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *trees)
+    plain = tree_weighted_mean(stacked, jnp.asarray(weights, jnp.float32))
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(secure[k]), np.asarray(plain[k]), atol=2e-2)
+
+
+class TinyGKTClient(nn.Module):
+    """Minimal edge model for the algorithm test: (logits, features)."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats = nn.relu(nn.Conv(8, (5, 5), (2, 2), padding=2)(x))
+        pooled = feats.mean(axis=(1, 2))
+        return nn.Dense(self.output_dim)(pooled), feats
+
+
+class TinyGKTServer(nn.Module):
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        x = nn.relu(nn.Conv(16, (3, 3), (2, 2), padding=1)(feats))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.output_dim)(nn.relu(nn.Dense(32)(x)))
+
+
+def test_fedgkt_knowledge_transfer():
+    from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+
+    ds = load_dataset("mnist", client_num_in_total=3, partition_method="homo",
+                      seed=0, flatten=False)
+    # shrink: cap per-client data so the CPU test stays fast
+    import dataclasses
+    from fedml_tpu.data.packing import PackedClients
+    n_cap = 96
+    ds = dataclasses.replace(
+        ds,
+        train=PackedClients(ds.train.x[:, :n_cap], ds.train.y[:, :n_cap],
+                            np.minimum(ds.train.counts, n_cap)),
+        test_global=(ds.test_global[0][:128], ds.test_global[1][:128]),
+    )
+    cfg = FedConfig(comm_round=4, epochs=25, lr=0.1,
+                    client_num_in_total=3, client_num_per_round=3)
+    api = FedGKTAPI(ds, cfg, TinyGKTClient(output_dim=10), TinyGKTServer(output_dim=10),
+                    alpha=0.5, temperature=1.0, server_epochs=25)
+    hist = api.train()
+    accs = [h["Test/Acc"] for h in hist]
+    assert accs[-1] > 0.3  # composed edge+server model learns
+    assert accs[-1] >= accs[0]
+
+
+def test_gkt_resnet_shapes():
+    """The reference-parity GKT split ResNets (resnet56_gkt) compose."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
+
+    x = jnp.zeros((2, 32, 32, 3))
+    cm = GKTClientResNet(output_dim=10, num_blocks=1)
+    cv = cm.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    logits, feats = cm.apply(cv, x, train=False)
+    assert logits.shape == (2, 10)
+    assert feats.shape == (2, 32, 32, 16)
+    sm = GKTServerResNet(output_dim=10, layers=(1, 1, 1))
+    sv = sm.init({"params": jax.random.PRNGKey(1)}, feats, train=False)
+    out = sm.apply(sv, feats, train=False)
+    assert out.shape == (2, 10)
