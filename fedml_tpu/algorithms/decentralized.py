@@ -60,13 +60,19 @@ def build_gossip_step(trainer, cfg: FedConfig, push_sum: bool = False) -> Callab
         )
         # x_{t+1/2} = x_t - lr * grad(z_t)  (client_pushsum.py:82-85)
         x_half = jax.tree.map(lambda x, g: x - cfg.lr * g, x_params, grads)
-        x_new = _mix(x_half, W)
         if push_sum:
-            omega_new = W @ omega
+            # push-sum sends with the SENDER's weights (reference
+            # send_local_gradient_to_neighbor weights by self.topology[index],
+            # client_pushsum.py:92-97) — the effective mix is W^T, which is
+            # column-stochastic w.r.t. the receiver, so omega mass evolves on
+            # directed graphs and z = x/omega de-biases the average.
+            x_new = _mix(x_half, W.T)
+            omega_new = W.T @ omega
             z_params = jax.tree.map(
                 lambda x: x / omega_new.reshape((-1,) + (1,) * (x.ndim - 1)), x_new
             )
         else:
+            x_new = _mix(x_half, W)
             omega_new = omega
             z_params = x_new
         z_new = dict(z_vars_stacked)
@@ -98,13 +104,10 @@ class DecentralizedFLAPI:
 
     def init_nodes(self, example_input) -> Any:
         rng = jax.random.PRNGKey(self.cfg.seed)
-        one = self.trainer.init(rng, example_input)
         # independent per-node models (reference creates one model per client)
-        stacked = jax.vmap(lambda k: self.trainer.init(k, example_input))(
+        return jax.vmap(lambda k: self.trainer.init(k, example_input))(
             jax.random.split(rng, self.n)
         )
-        del one
-        return stacked
 
     def run(self, x_stream, y_stream, iterations: int | None = None):
         """x_stream: [N, T, ...]; y_stream: [N, T, ...]."""

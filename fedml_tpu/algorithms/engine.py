@@ -172,6 +172,49 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
     return jax.jit(round_fn)
 
 
+def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -> Callable:
+    """R federated rounds as ONE jitted lax.scan — the dispatch-amortized fast
+    path. The whole federation's packed data lives on device; per round,
+    client sampling happens in-graph (jax.random.permutation prefix, the
+    in-XLA analog of the reference's np.random.seed(round_idx) choice at
+    FedAVGAggregator.py:89-97 — same distribution, different stream).
+
+    With client_num_per_round == total clients the per-round computation is
+    bit-identical to build_round_fn called sequentially with
+    rng = fold_in(base_rng, round_idx) (tested in tests/test_fedavg.py).
+    """
+    local_update = build_local_update(trainer, cfg)
+
+    def multi_round(global_variables, agg_state, x, y, counts, base_rng):
+        c_total = x.shape[0]
+        k = min(cfg.client_num_per_round, c_total)
+
+        def body(carry, round_idx):
+            gv, st = carry
+            rng = jax.random.fold_in(base_rng, round_idx)
+            if k < c_total:
+                idx = jax.random.permutation(jax.random.fold_in(rng, 0x5A11), c_total)[:k]
+            else:
+                idx = jnp.arange(c_total)
+            xs = jnp.take(x, idx, axis=0)
+            ys = jnp.take(y, idx, axis=0)
+            cs = jnp.take(counts, idx, axis=0)
+            crngs = jax.random.split(rng, k)
+            result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+                gv, xs, ys, cs, crngs
+            )
+            gv, st = aggregator(gv, result, cs.astype(jnp.float32), rng, st)
+            metrics = {mk: mv.sum() for mk, mv in result.metrics.items()}
+            return (gv, st), metrics
+
+        (gv, st), metrics = jax.lax.scan(
+            body, (global_variables, agg_state), jnp.arange(num_rounds)
+        )
+        return gv, st, metrics  # metrics leaves have leading [num_rounds]
+
+    return jax.jit(multi_round)
+
+
 def build_eval_fn(trainer) -> Callable:
     """Jitted eval over pre-packed [nb, b, ...] batches; returns metric sums."""
 

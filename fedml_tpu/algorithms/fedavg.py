@@ -94,9 +94,13 @@ class FedAvgAPI:
         )
         return {k: float(v) for k, v in train_metrics.items()}
 
-    def train(self) -> list[dict[str, Any]]:
+    def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
+              metrics_logger=None) -> list[dict[str, Any]]:
         cfg = self.cfg
-        for round_idx in range(cfg.comm_round):
+        start_round = 0
+        if ckpt_dir:
+            start_round = self.maybe_restore(ckpt_dir)
+        for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
             train_metrics = self.train_one_round(round_idx)
             jax.block_until_ready(self.global_variables)
@@ -105,8 +109,42 @@ class FedAvgAPI:
                 record.update(self.local_test_on_all_clients(round_idx))
                 record.update(self.test_global(round_idx))
             self.history.append(record)
+            if metrics_logger is not None:
+                metrics_logger.log({k: v for k, v in record.items() if k != "round"},
+                                   step=round_idx)
+            if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
+                self.save_checkpoint(ckpt_dir, round_idx + 1)
             log.info("round %d: %s (train %s)", round_idx, {k: v for k, v in record.items() if k != "round"}, train_metrics)
+        if ckpt_dir:
+            self.save_checkpoint(ckpt_dir, cfg.comm_round)
         return self.history
+
+    # ----------------------------------------------------------- checkpoints
+    def save_checkpoint(self, ckpt_dir: str, step: int):
+        """Persist global model + aggregator state + history (SURVEY §5:
+        the reference's core FedAvg cannot resume; this can)."""
+        from fedml_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(ckpt_dir, step, {
+            "tree": {"variables": self.global_variables, "agg_state": self.agg_state},
+            "meta": {"history": self.history},
+        })
+
+    def maybe_restore(self, ckpt_dir: str) -> int:
+        """Restore the latest checkpoint if present; returns the next round."""
+        from fedml_tpu.utils.checkpoint import restore_checkpoint
+
+        out = restore_checkpoint(
+            ckpt_dir, {"variables": self.global_variables, "agg_state": self.agg_state}
+        )
+        if out is None:
+            return 0
+        tree, step, meta = out
+        self.global_variables = tree["variables"]
+        self.agg_state = tree["agg_state"]
+        self.history = list(meta.get("history", []))
+        log.info("restored checkpoint at round %d from %s", step, ckpt_dir)
+        return step
 
     # ------------------------------------------------------------------- eval
     def test_global(self, round_idx: int) -> dict[str, float]:

@@ -90,6 +90,14 @@ class HierarchicalFLAPI:
             )
         self.round_fn = build_hierarchical_round_fn(trainer, cfg, group_comm_round)
         self.eval_fn = build_eval_fn(trainer)
+        # group assignment is fixed — stack [G, C, ...] arrays once, not per round
+        xs, ys, cs = [], [], []
+        for g in self.groups:
+            x, y, c = dataset.train.select(g)
+            xs.append(x); ys.append(y); cs.append(c)
+        self._x = jnp.asarray(np.stack(xs))
+        self._y = jnp.asarray(np.stack(ys))
+        self._counts = jnp.asarray(np.stack(cs))
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.global_variables = trainer.init(rng, jnp.asarray(dataset.train.x[:1, 0]))
@@ -97,15 +105,10 @@ class HierarchicalFLAPI:
         self._test_batches = pack_eval_batches(*dataset.test_global, max(bs, 64))
 
     def train_one_round(self, round_idx: int) -> dict[str, Any]:
-        xs, ys, cs = [], [], []
-        for g in self.groups:
-            x, y, c = self.dataset.train.select(g)
-            xs.append(x); ys.append(y); cs.append(c)
-        x = jnp.asarray(np.stack(xs))
-        y = jnp.asarray(np.stack(ys))
-        counts = jnp.asarray(np.stack(cs))
         rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
-        self.global_variables, metrics = self.round_fn(self.global_variables, x, y, counts, rng)
+        self.global_variables, metrics = self.round_fn(
+            self.global_variables, self._x, self._y, self._counts, rng
+        )
         return {k: float(v) for k, v in metrics.items()}
 
     def train(self):

@@ -28,19 +28,24 @@ from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
 
 
-def make_splitnn_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+def make_splitnn_optimizer(cfg: FedConfig, momentum: float | None = None,
+                           wd: float | None = None) -> optax.GradientTransformation:
     """Reference split_nn uses SGD(lr=0.1, momentum=0.9, wd=5e-4) on both
-    halves (client.py:18-19, server.py:19-20)."""
+    halves (client.py:18-19, server.py:19-20). `momentum`/`wd` None means the
+    reference defaults; pass explicit 0.0 to actually disable them
+    (cfg.momentum/cfg.wd are NOT consulted — their 0.0 default would be
+    indistinguishable from 'unset')."""
     return optax.chain(
-        optax.add_decayed_weights(cfg.wd if cfg.wd else 5e-4),
-        optax.sgd(cfg.lr, momentum=cfg.momentum if cfg.momentum else 0.9),
+        optax.add_decayed_weights(5e-4 if wd is None else wd),
+        optax.sgd(cfg.lr, momentum=0.9 if momentum is None else momentum),
     )
 
 
-def build_split_step(client_module, server_module, cfg: FedConfig) -> Callable:
+def build_split_step(client_module, server_module, cfg: FedConfig,
+                     momentum: float | None = None, wd: float | None = None) -> Callable:
     """One batch step: client-half forward -> server-half forward + CE loss ->
     grads through the composition -> separate optimizer updates."""
-    opt = make_splitnn_optimizer(cfg)
+    opt = make_splitnn_optimizer(cfg, momentum, wd)
 
     def step(client_params, server_params, c_opt, s_opt, batch):
         def loss_fn(cp, sp):
@@ -76,12 +81,13 @@ class SplitNNAPI:
     client -> client (reference semaphore messages)."""
 
     def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
-                 client_module, server_module):
+                 client_module, server_module,
+                 momentum: float | None = None, wd: float | None = None):
         self.dataset = dataset
         self.cfg = cfg
         self.client_module = client_module
         self.server_module = server_module
-        self.opt = make_splitnn_optimizer(cfg)
+        self.opt = make_splitnn_optimizer(cfg, momentum, wd)
 
         rng = jax.random.PRNGKey(cfg.seed)
         example = jnp.asarray(dataset.train.x[:1, 0])
@@ -100,7 +106,7 @@ class SplitNNAPI:
         ))(jax.random.split(rng, n_clients))
         self.server_opt = self.opt.init(self.server_params)
 
-        step = build_split_step(client_module, server_module, cfg)
+        step = build_split_step(client_module, server_module, cfg, momentum, wd)
 
         def client_epoch(cp, sp, co, so, x, y, count, rng):
             n_max = x.shape[0]

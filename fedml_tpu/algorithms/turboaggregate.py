@@ -192,5 +192,8 @@ class SecureAggregator:
         idx = list(range(self.t + 1))
         dec = bgw_decoding(share_sum[: self.t + 1], idx, self.p)[0]  # [n, 1]
         total = np.mod(dec[:, 0], self.p)
-        out = dequantize_vector(total, client_trees[0], self.frac_bits + 8, self.p)
-        return out
+        # normalize by the ACTUAL rounded-weight sum (sum(round(w*256)) is
+        # generally != 256, which would otherwise scale the model each round)
+        out = dequantize_vector(total, client_trees[0], self.frac_bits, self.p)
+        scale = 1.0 / float(wq.sum())
+        return jax.tree.map(lambda l: l * scale, out)

@@ -24,16 +24,7 @@ import numpy as np
 import optax
 
 
-class VFLParty:
-    """A party's feature slice [n, d_k] plus its local linear model — the
-    reference's VFLHostModel / the guest's local model (party_models.py)."""
-
-    def __init__(self, feature_dim: int, hidden: int = 0):
-        self.feature_dim = feature_dim
-        self.hidden = hidden  # 0 = plain logistic component
-
-
-def build_vfl_step(party_dims: list[int], cfg_lr: float) -> Callable:
+def build_vfl_step(cfg_lr: float) -> Callable:
     """Returns step(params_list, opt_states, xs, y) -> (params, opts, loss).
 
     params_list[k] = {"w": [d_k, 1], "b": [1]} for party k (guest is k=0 and
@@ -80,7 +71,7 @@ class VerticalFederatedLearningAPI:
             if k == 0:
                 p["b"] = jnp.zeros((1,), jnp.float32)
             self.params.append(p)
-        self.step = build_vfl_step([len(c) for c in feature_splits], lr)
+        self.step = build_vfl_step(lr)
         opt = optax.sgd(lr)
         self.opt_states = [opt.init(p) for p in self.params]
         self.loss_history: list[float] = []

@@ -81,9 +81,21 @@ class ClassificationTrainer(ModelTrainer):
 
     Loss is the masked mean of per-sample CE over the batch — identical to
     torch's ``CrossEntropyLoss()`` mean reduction on the valid samples.
+
+    ``augment_fn(rng, x) -> x`` runs inside the jitted train step (the
+    TPU-native home of the reference's torchvision train transforms —
+    fedml_tpu.data.augment).
     """
 
+    def __init__(self, module, id: int = 0, augment_fn=None):
+        super().__init__(module, id)
+        self.augment_fn = augment_fn
+
     def loss_fn(self, variables, batch, rng, train: bool = True):
+        x = batch["x"]
+        if train and self.augment_fn is not None and rng is not None:
+            x = self.augment_fn(jax.random.fold_in(rng, 17), x)
+        batch = dict(batch, x=x)
         logits, new_state = self.apply(variables, batch["x"], rng, train)
         per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         mask = batch["mask"].astype(per.dtype)

@@ -57,13 +57,20 @@ def pack_client_data(
     counts = np.array([len(dataidx_map[i]) for i in range(client_num)], dtype=np.int32)
     if n_max is None:
         n_max = int(counts.max())
-    px = np.zeros((client_num, n_max) + x.shape[1:], dtype=x.dtype)
-    py = np.zeros((client_num, n_max) + y.shape[1:], dtype=y.dtype)
-    for i in range(client_num):
-        idx = np.asarray(dataidx_map[i], dtype=int)[:n_max]
-        px[i, : len(idx)] = x[idx]
-        py[i, : len(idx)] = y[idx]
-        counts[i] = min(counts[i], n_max)
+    idx_lists = [np.asarray(dataidx_map[i], dtype=np.int64) for i in range(client_num)]
+    try:  # native C++ gather (fedml_tpu/native/packing.cpp) — same output
+        from fedml_tpu import native
+
+        px = native.pack_rows(x, idx_lists, n_max)
+        py = native.pack_rows(y, idx_lists, n_max)
+    except Exception:
+        px = np.zeros((client_num, n_max) + x.shape[1:], dtype=x.dtype)
+        py = np.zeros((client_num, n_max) + y.shape[1:], dtype=y.dtype)
+        for i in range(client_num):
+            idx = idx_lists[i][:n_max]
+            px[i, : len(idx)] = x[idx]
+            py[i, : len(idx)] = y[idx]
+    np.minimum(counts, n_max, out=counts)
     return PackedClients(px, py, counts)
 
 

@@ -359,10 +359,13 @@ def load_stackoverflow_nwp_clients(data_dir: str = "./data", client_num: int = 2
         import h5py
 
         if os.path.exists(train_h5) and os.path.exists(test_h5):
+            import zlib
+
             def tok_ids(sentence):
                 words = sentence.decode() if isinstance(sentence, bytes) else str(sentence)
                 # 0=pad,1=bos,2=eos; oov/regular hashed into [4, vocab_size)
-                ids = [1] + [4 + (hash(w) % (vocab_size - 4)) for w in words.split()][: seq_len - 2] + [2]
+                # via crc32 — deterministic across processes, unlike hash()
+                ids = [1] + [4 + (zlib.crc32(w.encode()) % (vocab_size - 4)) for w in words.split()][: seq_len - 2] + [2]
                 ids = ids + [0] * (seq_len + 1 - len(ids))
                 return np.array(ids[: seq_len + 1], np.int32)
 

@@ -1,0 +1,2 @@
+"""Experiment launchers (L4) — argparse mains mirroring the reference's
+fedml_experiments/ entry points, driving the TPU-native algorithm APIs."""

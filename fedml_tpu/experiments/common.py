@@ -1,0 +1,97 @@
+"""Shared experiment plumbing: the reference's argparse surface + run setup.
+
+Flag names follow reference fedml_experiments/distributed/fedavg/
+main_fedavg.py:46-112 verbatim so launch scripts transfer; GPU-mapping flags
+are replaced by mesh flags (SURVEY §2.2 gpu_mapping -> jax.sharding.Mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import random
+
+import numpy as np
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import (
+    ClassificationTrainer,
+    NWPTrainer,
+    TagPredictionTrainer,
+)
+from fedml_tpu.data.registry import FederatedDataset, load_dataset
+from fedml_tpu.models.registry import create_model
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Reference add_args (main_fedavg.py:46-112), TPU-adapted."""
+    parser.add_argument("--model", type=str, default="lr")
+    parser.add_argument("--dataset", type=str, default="mnist")
+    parser.add_argument("--data_dir", type=str, default="./data")
+    parser.add_argument("--partition_method", type=str, default="hetero")
+    parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--client_num_in_total", type=int, default=10)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=10)
+    parser.add_argument("--client_optimizer", type=str, default="sgd")
+    parser.add_argument("--lr", type=float, default=0.03)
+    parser.add_argument("--wd", type=float, default=0.0)
+    parser.add_argument("--momentum", type=float, default=0.0)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--comm_round", type=int, default=10)
+    parser.add_argument("--frequency_of_the_test", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ci", type=int, default=0)
+    # TPU-native replacements for gpu_server_num / gpu_mapping_file
+    parser.add_argument("--backend", type=str, default="vmap",
+                        choices=["vmap", "shard_map"])
+    parser.add_argument("--mesh_shape", type=int, nargs="*", default=None)
+    parser.add_argument("--ckpt_dir", type=str, default=None)
+    parser.add_argument("--run_dir", type=str, default="./wandb/latest-run/files")
+    parser.add_argument("--fedprox_mu", type=float, default=0.0)
+    return parser
+
+
+def config_from_args(args) -> FedConfig:
+    d = {k: v for k, v in vars(args).items() if v is not None}
+    d.pop("data_dir", None)
+    d.pop("ckpt_dir", None)
+    d.pop("run_dir", None)
+    if d.get("mesh_shape"):
+        d["mesh_shape"] = tuple(d["mesh_shape"])
+    else:
+        d.pop("mesh_shape", None)
+    return FedConfig.from_dict(d)
+
+
+def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
+    """Seeds + logging + data + model + task trainer (reference main
+    preamble, main_fedavg.py:262-320: trainer chosen by dataset)."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+    )
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+    cfg = config_from_args(args)
+    ds = load_dataset(
+        args.dataset,
+        data_dir=args.data_dir,
+        client_num_in_total=args.client_num_in_total,
+        partition_method=args.partition_method,
+        partition_alpha=args.partition_alpha,
+        seed=args.seed,
+    )
+    model_kwargs = {}
+    if args.dataset in ("shakespeare", "fed_shakespeare"):
+        model_kwargs["vocab_size"] = 90
+        model_kwargs["per_position"] = args.dataset == "fed_shakespeare"
+    module = create_model(args.model, output_dim=ds.class_num, **model_kwargs)
+    # task trainer by dataset (reference FedAvgAPI.py:33-39)
+    if ds.meta.get("task") == "nwp" or args.dataset in ("fed_shakespeare", "stackoverflow_nwp"):
+        trainer = NWPTrainer(module, pad_id=0)
+    elif ds.meta.get("task") == "tag_prediction" or args.dataset == "stackoverflow_lr":
+        trainer = TagPredictionTrainer(module)
+    else:
+        trainer = ClassificationTrainer(module)
+    return cfg, ds, trainer

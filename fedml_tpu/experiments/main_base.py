@@ -1,0 +1,23 @@
+"""Base-framework smoke main (reference fedml_experiments/distributed/base/
+— the CI framework smoke test target, CI-script-framework.sh:16-23)."""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.base_framework import FedML_Base_simulated
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--client_num", type=int, default=4)
+    parser.add_argument("--comm_round", type=int, default=3)
+    args = parser.parse_args(argv)
+    out = FedML_Base_simulated(args.client_num,
+                               lambda i, r: float(i + r), args.comm_round)
+    print("aggregated:", out)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""FedAvg experiment main (reference fedml_experiments/distributed/fedavg/
+main_fedavg.py:262-328 — the north-star entry). Subsumes the standalone main
+(standalone/fedavg/main_fedavg.py:216-366): backend=vmap is the standalone
+simulator, backend=shard_map is the distributed deployment on a mesh.
+
+Usage:
+  python -m fedml_tpu.experiments.main_fedavg --dataset mnist --model lr \
+      --client_num_in_total 1000 --client_num_per_round 10 --comm_round 100
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None, aggregator_name: str = "fedavg", extra_args=None):
+    parser = add_args(argparse.ArgumentParser())
+    if extra_args:
+        extra_args(parser)
+    args = parser.parse_args(argv)
+    cfg, ds, trainer = setup_run(args)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
+    history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger)
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Robust FedAvg experiment main (reference fedml_experiments/distributed/
+fedavg_robust/ — norm-clipping + weak-DP defense aggregation)."""
+
+from __future__ import annotations
+
+from fedml_tpu.experiments.main_fedavg import main as fedavg_main
+
+
+def _extra(parser):
+    parser.add_argument("--norm_bound", type=float, default=5.0)
+    parser.add_argument("--stddev", type=float, default=0.025)
+
+
+def main(argv=None):
+    return fedavg_main(argv, aggregator_name="robust", extra_args=_extra)
+
+
+if __name__ == "__main__":
+    main()

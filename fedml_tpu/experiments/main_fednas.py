@@ -1,0 +1,33 @@
+"""FedNAS experiment main (reference fedml_experiments/distributed/fednas/)."""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.fednas import FedNASAPI
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    parser.add_argument("--init_channels", type=int, default=8)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--arch_lr", type=float, default=3e-4)
+    parser.add_argument("--unrolled", type=int, default=0)
+    args = parser.parse_args(argv)
+    cfg, ds, _ = setup_run(args)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = FedNASAPI(ds, cfg, channels=args.init_channels, layers=args.layers,
+                    arch_lr=args.arch_lr, unrolled=bool(args.unrolled))
+    history = api.train()
+    for rec in history:
+        logger.log({"search_loss": rec["search_loss"]}, step=rec["round"])
+    # reference records the genotype each round (FedNASAggregator.py:173)
+    logger.log({"genotype": str(api.genotype_history[-1])})
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()

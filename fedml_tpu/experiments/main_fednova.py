@@ -1,0 +1,14 @@
+"""FedNova experiment main (reference fedml_experiments/standalone/fednova/).
+FedProx is its --fedprox_mu flag (reference fednova.py:124-126 mu term)."""
+
+from __future__ import annotations
+
+from fedml_tpu.experiments.main_fedavg import main as fedavg_main
+
+
+def main(argv=None):
+    return fedavg_main(argv, aggregator_name="fednova")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""FedOpt experiment main (reference fedml_experiments/distributed/fedopt/
+main_fedopt.py — adds --server_optimizer/--server_lr, main_fedopt.py:54-60)."""
+
+from __future__ import annotations
+
+from fedml_tpu.experiments.main_fedavg import main as fedavg_main
+
+
+def _extra(parser):
+    parser.add_argument("--server_optimizer", type=str, default="adam")
+    parser.add_argument("--server_lr", type=float, default=0.001)
+    parser.add_argument("--server_momentum", type=float, default=0.0)
+
+
+def main(argv=None):
+    return fedavg_main(argv, aggregator_name="fedopt", extra_args=_extra)
+
+
+if __name__ == "__main__":
+    main()

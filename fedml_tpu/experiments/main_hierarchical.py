@@ -1,0 +1,30 @@
+"""Hierarchical FL experiment main (reference fedml_experiments/standalone/
+hierarchical_fl/ — --group_num / --group_comm_round)."""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    parser.add_argument("--group_num", type=int, default=2)
+    parser.add_argument("--group_comm_round", type=int, default=1)
+    args = parser.parse_args(argv)
+    cfg, ds, trainer = setup_run(args)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = HierarchicalFLAPI(ds, cfg, trainer, group_num=args.group_num,
+                            group_comm_round=args.group_comm_round)
+    history = api.train()
+    for rec in history:
+        logger.log({k: v for k, v in rec.items() if k != "round"}, step=rec["round"])
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -12,42 +12,54 @@ Inputs are NHWC [b, 28, 28, 1] / [b, 32, 32, 3] — the TPU-native layout
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class CNN_OriginalFedAvg(nn.Module):
-    """2x(5x5 conv SAME + 2x2 maxpool) -> 512 dense -> out."""
+    """2x(5x5 conv SAME + 2x2 maxpool) -> 512 dense -> out.
+
+    ``dtype`` sets the activation/compute dtype (bfloat16 feeds the MXU at
+    full rate; parameters stay float32)."""
 
     output_dim: int = 10
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME", name="conv2d_1")(x))
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv2d_1")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", name="conv2d_2")(x))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2d_2")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512, name="linear_1")(x))
-        return nn.Dense(self.output_dim, name="linear_2")(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype, name="linear_1")(x))
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="linear_2")(x).astype(jnp.float32)
 
 
 class CNN_DropOut(nn.Module):
     """3x3 VALID convs 32/64 -> maxpool -> drop .25 -> 128 dense -> drop .5 -> out.
 
-    The flagship cross-device model (FEMNIST 84.9% target, BASELINE.md)."""
+    The flagship cross-device model (FEMNIST 84.9% target, BASELINE.md).
+    ``dtype`` = activation/compute dtype (bfloat16 for the MXU fast path;
+    params stay float32, logits are cast back to float32)."""
 
     output_dim: int = 10
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv2d_1")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2d_2")(x))
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype, name="conv2d_1")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype, name="conv2d_2")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(128, name="linear_1")(x))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype, name="linear_1")(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(self.output_dim, name="linear_2")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="linear_2")(x).astype(jnp.float32)
 
 
 class HAR_CNN(nn.Module):

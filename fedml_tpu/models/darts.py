@@ -1,0 +1,275 @@
+"""DARTS search space, flax/NHWC — the FedNAS model.
+
+Behavior-parity rebuild of reference fedml_api/model/cv/darts/
+(operations.py:4-13 OPS, genotypes.py:5-14 PRIMITIVES, model_search.py:10-306
+MixedOp/Cell/Network/genotype parse). Architecture parameters (alphas) are
+explicit call inputs rather than module parameters so the bi-level
+weight/alpha optimization holds them in separate optimizer states
+(fedml_tpu.algorithms.fednas).
+
+Search-phase BatchNorm is affine-free and uses *batch* statistics (stateless
+standardization) — matching the reference's affine=False search BN in train
+mode without carrying running stats through the bi-level grads.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+Genotype = namedtuple("Genotype", "normal normal_concat reduce reduce_concat")
+
+
+def _bn(x):
+    """Stateless affine-free batch standardization over (N, H, W)."""
+    mean = x.mean(axis=(0, 1, 2), keepdims=True)
+    var = x.var(axis=(0, 1, 2), keepdims=True)
+    return (x - mean) / jnp.sqrt(var + 1e-5)
+
+
+class ReLUConvBN(nn.Module):
+    out_ch: int
+    kernel: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(self.out_ch, (self.kernel, self.kernel),
+                    (self.stride, self.stride), padding=self.kernel // 2,
+                    use_bias=False)(x)
+        return _bn(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduce: two offset 1x1/2 convs concatenated
+    (reference operations.py FactorizedReduce)."""
+
+    out_ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        a = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False)(x)
+        b = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False)(x[:, 1:, 1:, :])
+        return _bn(jnp.concatenate([a, b], axis=-1))
+
+
+class SepConv(nn.Module):
+    """ReLU-sepconv-BN twice (reference SepConv)."""
+
+    out_ch: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        pad = self.kernel // 2
+        x = nn.relu(x)
+        x = nn.Conv(c, (self.kernel, self.kernel), (self.stride, self.stride),
+                    padding=pad, feature_group_count=c, use_bias=False)(x)
+        x = nn.Conv(c, (1, 1), use_bias=False)(x)
+        x = _bn(x)
+        x = nn.relu(x)
+        x = nn.Conv(c, (self.kernel, self.kernel), padding=pad,
+                    feature_group_count=c, use_bias=False)(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False)(x)
+        return _bn(x)
+
+
+class DilConv(nn.Module):
+    """ReLU-dilated-sepconv-BN (reference DilConv)."""
+
+    out_ch: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        pad = (self.kernel - 1) * self.dilation // 2
+        x = nn.relu(x)
+        x = nn.Conv(c, (self.kernel, self.kernel), (self.stride, self.stride),
+                    padding=pad, kernel_dilation=self.dilation,
+                    feature_group_count=c, use_bias=False)(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False)(x)
+        return _bn(x)
+
+
+def _pool(x, kind: str, stride: int):
+    win, s, pad = (3, 3), (stride, stride), ((1, 1), (1, 1))
+    if kind == "max":
+        return nn.max_pool(x, win, strides=s, padding=pad)
+    # count_include_pad=False average pooling
+    ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+    summed = nn.avg_pool(x, win, strides=s, padding=pad, count_include_pad=True) * 9.0
+    denom = nn.avg_pool(ones, win, strides=s, padding=pad, count_include_pad=True) * 9.0
+    return summed / denom
+
+
+class MixedOp(nn.Module):
+    """Weighted sum of all candidate ops (reference model_search.py:10-23;
+    pools get the affine-free BN the reference appends)."""
+
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights):
+        c = x.shape[-1]
+        outs = []
+        for prim in PRIMITIVES:
+            if prim == "none":
+                if self.stride == 1:
+                    o = jnp.zeros_like(x)
+                else:
+                    o = jnp.zeros(x[:, ::2, ::2, :].shape, x.dtype)
+            elif prim == "max_pool_3x3":
+                o = _bn(_pool(x, "max", self.stride))
+            elif prim == "avg_pool_3x3":
+                o = _bn(_pool(x, "avg", self.stride))
+            elif prim == "skip_connect":
+                o = x if self.stride == 1 else FactorizedReduce(c)(x)
+            elif prim == "sep_conv_3x3":
+                o = SepConv(c, 3, self.stride)(x)
+            elif prim == "sep_conv_5x5":
+                o = SepConv(c, 5, self.stride)(x)
+            elif prim == "dil_conv_3x3":
+                o = DilConv(c, 3, self.stride, 2)(x)
+            elif prim == "dil_conv_5x5":
+                o = DilConv(c, 5, self.stride, 2)(x)
+            outs.append(o)
+        stacked = jnp.stack(outs)  # [ops, b, h, w, c]
+        return jnp.tensordot(weights, stacked, axes=(0, 0))
+
+
+class Cell(nn.Module):
+    """DARTS cell: 2 input nodes + `steps` intermediate nodes, output =
+    concat of the last `multiplier` states (reference model_search.py:26-60)."""
+
+    channels: int
+    reduction: bool
+    reduction_prev: bool
+    steps: int = 4
+    multiplier: int = 4
+
+    @nn.compact
+    def __call__(self, s0, s1, weights):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.channels)(s0)
+        else:
+            s0 = ReLUConvBN(self.channels)(s0)
+        s1 = ReLUConvBN(self.channels)(s1)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = sum(
+                MixedOp(stride=2 if self.reduction and j < 2 else 1)(h, weights[offset + j])
+                for j, h in enumerate(states)
+            )
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class DARTSNetwork(nn.Module):
+    """Search network (reference Network, model_search.py:172-240): stem,
+    `layers` cells (reduction at 1/3 and 2/3), gap, classifier.
+
+    __call__(x, alphas_normal, alphas_reduce) with alphas [k, |PRIMITIVES|],
+    k = sum_{i<steps}(2+i) = 14.
+    """
+
+    output_dim: int = 10
+    channels: int = 16
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+
+    @property
+    def num_edges(self) -> int:
+        return sum(2 + i for i in range(self.steps))
+
+    @nn.compact
+    def __call__(self, x, alphas_normal, alphas_reduce, train: bool = False):
+        wn = nn.softmax(alphas_normal, axis=-1)
+        wr = nn.softmax(alphas_reduce, axis=-1)
+        c_curr = self.stem_multiplier * self.channels
+        s = nn.Conv(c_curr, (3, 3), padding=1, use_bias=False, name="stem")(x)
+        s0 = s1 = _bn(s)
+        c_curr = self.channels
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            s0, s1 = s1, Cell(
+                channels=c_curr, reduction=reduction, reduction_prev=reduction_prev,
+                steps=self.steps, multiplier=self.multiplier, name=f"cell{i}"
+            )(s0, s1, wr if reduction else wn)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.output_dim, name="classifier")(out)
+
+
+def init_alphas(rng, steps: int = 4, scale: float = 1e-3):
+    """1e-3 * randn init (reference _initialize_alphas, model_search.py:241)."""
+    import jax
+
+    k = sum(2 + i for i in range(steps))
+    r1, r2 = jax.random.split(rng)
+    return (scale * jax.random.normal(r1, (k, len(PRIMITIVES))),
+            scale * jax.random.normal(r2, (k, len(PRIMITIVES))))
+
+
+def parse_genotype(alphas_normal, alphas_reduce, steps: int = 4, multiplier: int = 4):
+    """argmax-over-alpha genotype extraction (reference Network.genotype,
+    model_search.py:268-306): per node keep the 2 strongest input edges, each
+    with its best non-'none' op."""
+
+    def softmax(a):
+        e = np.exp(a - a.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    none_idx = PRIMITIVES.index("none")
+
+    def _parse(weights):
+        gene, start, n = [], 0, 2
+        for i in range(steps):
+            W = weights[start:start + n]
+            edges = sorted(
+                range(n),
+                key=lambda j: -max(W[j][k] for k in range(len(PRIMITIVES)) if k != none_idx),
+            )[:2]
+            for j in sorted(edges):
+                k_best = max(
+                    (k for k in range(len(PRIMITIVES)) if k != none_idx),
+                    key=lambda k: W[j][k],
+                )
+                gene.append((PRIMITIVES[k_best], j))
+            start += n
+            n += 1
+        return gene
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(
+        normal=_parse(softmax(np.asarray(alphas_normal))), normal_concat=concat,
+        reduce=_parse(softmax(np.asarray(alphas_reduce))), reduce_concat=concat,
+    )

@@ -1,0 +1,75 @@
+"""AdaptiveCNN + heterogeneous branch architectures (fork ensembles).
+
+Behavior-parity rebuild of reference fedml_api/model/ensemble/cnn.py:15-310:
+a CNN_DropOut-shaped base whose four blocks (conv1 / conv2 / linear1 /
+linear2) can be independently deepened/widened per branch; every variant
+keeps its block's *output* dimensionality (reference adjust_last_conv_width
+pins out_channels), so same-arch blocks can still be averaged across
+branches (the blockavg ensemble) while hetero blocks differ internally.
+
+An architecture is data (`ArchSpec`: per-block INTERNAL layer widths; () =
+the base single-layer block), not code. `build_hetero_archs(n)` returns n
+specs cycling the reference's widen/deepen variants (cnn.py:256-300:
+widen = +16 channels, deepen = add a layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+
+
+@dataclass(frozen=True)
+class ArchSpec:
+    conv1: tuple = ()    # internal conv widths before the fixed 32-ch output conv
+    conv2: tuple = ()    # ... before the fixed 64-ch output conv
+    linear1: tuple = ()  # internal dense widths before the fixed 128-d output
+
+    def describe(self) -> str:
+        return f"conv1{list(self.conv1)}--conv2{list(self.conv2)}--lin1{list(self.linear1)}"
+
+
+CONV1_VARIANTS = ((), (16,), (32,), (48, 48))
+CONV2_VARIANTS = ((), (48,), (64,), (80, 80))
+LINEAR1_VARIANTS = ((), (512,))
+
+
+def build_hetero_archs(num_branch: int) -> list[ArchSpec]:
+    """One ArchSpec per branch, cycling block variants (reference
+    build_hetero_archs repeats each block's variants across branches)."""
+    return [
+        ArchSpec(
+            conv1=CONV1_VARIANTS[b % len(CONV1_VARIANTS)],
+            conv2=CONV2_VARIANTS[(b // 2) % len(CONV2_VARIANTS)],
+            linear1=LINEAR1_VARIANTS[b % len(LINEAR1_VARIANTS)],
+        )
+        for b in range(num_branch)
+    ]
+
+
+class AdaptiveCNN(nn.Module):
+    """conv1 block -> conv2 block + maxpool -> linear1 (dropout .25) ->
+    dropout .5 + linear2 (reference AdaptiveCNN.forward, cnn.py:68-110).
+    Block output dims are fixed (32 / 64 / 128 / output_dim) regardless of
+    the internal arch, exactly like the reference's variants."""
+
+    output_dim: int = 10
+    arch: ArchSpec = field(default_factory=ArchSpec)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, w in enumerate(self.arch.conv1):
+            x = nn.relu(nn.Conv(w, (3, 3), padding=1, name=f"conv1_{i}")(x))
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv1_out")(x))
+        for i, w in enumerate(self.arch.conv2):
+            x = nn.relu(nn.Conv(w, (3, 3), padding=1, name=f"conv2_{i}")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2_out")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        for i, w in enumerate(self.arch.linear1):
+            x = nn.relu(nn.Dense(w, name=f"linear1_{i}")(x))
+        x = nn.relu(nn.Dense(128, name="linear1_out")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.output_dim, name="linear2_out")(x)

@@ -29,7 +29,10 @@ def _cnn_fedavg(output_dim, **kw):
 @register_model("cnn")
 def _cnn(output_dim, **kw):
     # reference "cnn" for femnist = CNN_DropOut (main_fedavg.py:233-236)
-    return CNN_DropOut(output_dim=output_dim)
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if kw.get("dtype") == "bfloat16" else jnp.float32
+    return CNN_DropOut(output_dim=output_dim, dtype=dtype)
 
 
 @register_model("cnn_cifar")

@@ -1,0 +1,84 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+Compiles `packing.cpp` to `libfedpack.so` on first import (g++, no deps) and
+exposes `pack_rows` — the fast path under
+`fedml_tpu.data.packing.pack_client_data`. Falls back silently to the numpy
+implementation when no compiler is available, so the package never hard-fails.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(__file__)
+_SO_PATH = os.path.join(_HERE, "libfedpack.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_HERE, "packing.cpp")
+        try:
+            if (not os.path.exists(_SO_PATH)
+                    or os.path.getmtime(_SO_PATH) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", src, "-o", _SO_PATH],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.pack_rows.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+            ]
+            lib.pack_rows.restype = None
+            _lib = lib
+        except Exception as e:
+            log.info("native packing unavailable (%s); numpy fallback in use", e)
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def pack_rows(src: np.ndarray, idx_lists: list[np.ndarray], n_max: int) -> np.ndarray:
+    """Gather per-client row indices of `src` into a zero-padded
+    [n_clients, n_max, ...] array using the C++ kernel.
+
+    Raises RuntimeError when the native library is unavailable — callers
+    (fedml_tpu.data.packing) catch and fall back to numpy.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing unavailable")
+    src = np.ascontiguousarray(src)
+    n_clients = len(idx_lists)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    idx = np.ascontiguousarray(np.concatenate([np.asarray(i, np.int64) for i in idx_lists])
+                               if idx_lists else np.zeros(0, np.int64), dtype=np.int64)
+    offsets = np.zeros(n_clients + 1, np.int64)
+    np.cumsum([len(i) for i in idx_lists], out=offsets[1:])
+    out = np.zeros((n_clients, n_max) + src.shape[1:], src.dtype)
+    lib.pack_rows(
+        src.ctypes.data_as(ctypes.c_char_p), row_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n_clients, n_max, out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out

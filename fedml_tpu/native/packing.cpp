@@ -1,0 +1,51 @@
+// Native client-packing kernels — the host-side data-plane hot path.
+//
+// The reference framework is pure Python (SURVEY §2.9: no native components;
+// its "native layer" is MPI/torch). Here the TPU compute path is XLA; this
+// extension is the native runtime piece for the *host* side of the pipeline:
+// packing thousands of variable-size client shards into the fixed-shape
+// [clients, n_max, ...] arrays the jitted rounds consume
+// (fedml_tpu/data/packing.py falls back to numpy loops when this .so is
+// unavailable).
+//
+// Build: g++ -O3 -march=native -shared -fPIC packing.cpp -o libfedpack.so
+// (done automatically by fedml_tpu.native on first import).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Gather rows of `src` (n_rows x row_bytes, contiguous) into the padded
+// [n_clients, n_max, row_bytes] buffer `dst` (pre-zeroed by the caller).
+// idx: concatenated per-client row indices; offsets: [n_clients + 1] bounds
+// into idx. Rows beyond n_max per client are dropped (caller clamps counts).
+void pack_rows(const char* src, int64_t row_bytes, const int64_t* idx,
+               const int64_t* offsets, int64_t n_clients, int64_t n_max,
+               char* dst) {
+  for (int64_t c = 0; c < n_clients; ++c) {
+    const int64_t start = offsets[c];
+    int64_t count = offsets[c + 1] - start;
+    if (count > n_max) count = n_max;
+    char* client_dst = dst + c * n_max * row_bytes;
+    for (int64_t i = 0; i < count; ++i) {
+      std::memcpy(client_dst + i * row_bytes, src + idx[start + i] * row_bytes,
+                  row_bytes);
+    }
+  }
+}
+
+// Same gather for naturally-split clients already stored back to back:
+// starts[c] is the row offset of client c in src, counts[c] its row count.
+void pack_ranges(const char* src, int64_t row_bytes, const int64_t* starts,
+                 const int64_t* counts, int64_t n_clients, int64_t n_max,
+                 char* dst) {
+  for (int64_t c = 0; c < n_clients; ++c) {
+    int64_t count = counts[c];
+    if (count > n_max) count = n_max;
+    std::memcpy(dst + c * n_max * row_bytes, src + starts[c] * row_bytes,
+                count * row_bytes);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Cross-silo multi-process deployment — the mpirun replacement.
+
+The reference deploys with `mpirun -np N+1` + a hostfile and moves pickled
+state_dicts point-to-point (SURVEY §3.1). The TPU-native deployment is a JAX
+multi-process run: one process per silo/host, all devices form one global
+mesh, and every exchange is an XLA collective over ICI/DCN
+(`jax.distributed.initialize` + `multihost_utils`, per SURVEY §2.9's
+"distributed communication backend" row).
+
+Control-plane messages (sampling indices, eval stats) ride
+`broadcast_one_to_all` / `process_allgather` on DCN; the model average rides
+the in-graph psum/all_gather of the sharded round. There are no send/recv
+threads, no 0.3 s poll loops, no MPI.Abort shutdown (SURVEY §7 defects).
+
+Single-process runs (process_count == 1) degrade to no-ops so the same
+training script works from a laptop to a multi-host pod.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def init_multihost(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> dict[str, int]:
+    """Initialize the JAX distributed runtime (idempotent; no-op when
+    unconfigured single-process). Returns topology info."""
+    if coordinator_address is not None:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:  # already initialized
+            log.info("jax.distributed already initialized: %s", e)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def broadcast_from_server(value: Any) -> Any:
+    """Process-0 value -> every process (the reference's send_init_msg /
+    sync broadcast, FedAvgServerManager.py:31-37, as one DCN collective)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def allgather_metrics(local_metrics: dict[str, float]) -> dict[str, float]:
+    """Sum scalar metrics across processes (replaces per-client MPI metric
+    messages feeding server-side eval, FedAVGAggregator.py:109-163)."""
+    if jax.process_count() == 1:
+        return dict(local_metrics)
+    from jax.experimental import multihost_utils
+
+    keys = sorted(local_metrics)
+    vec = np.asarray([local_metrics[k] for k in keys], np.float64)
+    gathered = multihost_utils.process_allgather(vec)
+    summed = np.asarray(gathered).sum(axis=0)
+    return {k: float(v) for k, v in zip(keys, summed)}
+
+
+def assert_same_across_processes(value: np.ndarray, name: str = "value"):
+    """Cross-host agreement check (debugging aid for silo drift; the
+    reference has no equivalent — SURVEY §5 race/failure detection gaps)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(value, f"{name} differs across processes")
+
+
+def round_barrier(name: str, round_idx: int):
+    """Named sync point between rounds (replaces the implicit MPI ordering)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"{name}_{round_idx}")

@@ -1,0 +1,10 @@
+"""Privacy research package — branch/ensemble FL + membership-inference and
+adversarial-robustness evaluation.
+
+Rebuild of the fork's privacy_fedml/ (SURVEY §2.8): branch-wise FedAvg with
+server-side ensembles (pred-avg / pred-vote / pred-weight / block-avg /
+hetero-ensemble), MI attacks (shadow-NN, loss, top-k, gradient-norm), and
+native FGSM/PGD adversarial evaluation (replacing the foolbox dependency).
+"""
+
+from fedml_tpu.privacy.branch_fedavg import BranchFedAvgAPI  # noqa: F401

@@ -1,0 +1,162 @@
+"""Membership-inference attacks against a federated model.
+
+Behavior-parity rebuild of reference privacy_fedml/MI_attack/
+(NN_attack.py:20-130 shadow-NN attack on prediction vectors, loss attack,
+top-3 attack, gradient attack). Attack data = the target model's outputs on
+the adversary client's train split (members) vs test split (non-members);
+the metric is attack accuracy / advantage on held-out member/non-member
+pairs from *other* clients (reference eval_on_other_client).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class NNAttackModel(nn.Module):
+    """4-layer MLP attack classifier (reference NN_attack.py:20-40:
+    input -> 512 -> 256 -> 128 -> 2)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Dense(512)(x))
+        x = nn.relu(nn.Dense(256)(x))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(2)(x)
+
+
+def _prediction_features(predict_fn: Callable, x: jnp.ndarray, top_k: int | None = None):
+    """Sorted softmax vector (optionally top-k) — the MI feature the
+    reference feeds the attack model."""
+    probs = jax.nn.softmax(predict_fn(x), axis=-1)
+    feats = jnp.sort(probs, axis=-1)[:, ::-1]
+    if top_k is not None:
+        feats = feats[:, :top_k]
+    return feats
+
+
+def attack_dataset(predict_fn, member_x, nonmember_x, top_k: int | None = None):
+    """(features, labels): members=1, non-members=0."""
+    fm = _prediction_features(predict_fn, member_x, top_k)
+    fn_ = _prediction_features(predict_fn, nonmember_x, top_k)
+    x = jnp.concatenate([fm, fn_])
+    y = jnp.concatenate([jnp.ones(len(fm), jnp.int32), jnp.zeros(len(fn_), jnp.int32)])
+    return x, y
+
+
+class NNAttack:
+    """Shadow-model NN attack (reference NNAttack, NN_attack.py:59): train the
+    MLP on the adversary's member/non-member prediction vectors, evaluate on
+    other clients' data. `top_k=3` gives the reference's top-3 variant."""
+
+    def __init__(self, top_k: int | None = None, lr: float = 0.1,
+                 epochs: int = 40, batch_size: int = 64, seed: int = 0):
+        self.top_k = top_k
+        self.lr = lr
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.model = NNAttackModel()
+        self.variables = None
+
+    def fit(self, predict_fn, member_x, nonmember_x):
+        x, y = attack_dataset(predict_fn, member_x, nonmember_x, self.top_k)
+        rng = jax.random.PRNGKey(self.seed)
+        v = self.model.init({"params": rng}, x[:1])
+        opt = optax.sgd(self.lr, momentum=0.9)
+        st = opt.init(v["params"])
+
+        @jax.jit
+        def step(params, st, bx, by):
+            def loss(p):
+                logits = self.model.apply({"params": p}, bx)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+            g = jax.grad(loss)(params)
+            upd, st2 = opt.update(g, st, params)
+            return optax.apply_updates(params, upd), st2
+
+        params = v["params"]
+        n = len(y)
+        nprng = np.random.RandomState(self.seed)
+        for e in range(self.epochs):
+            order = nprng.permutation(n)
+            for s in range(0, n - self.batch_size + 1, self.batch_size):
+                i = order[s:s + self.batch_size]
+                params, st = step(params, st, x[i], y[i])
+        self.variables = {"params": params}
+        return self
+
+    def score(self, predict_fn, member_x, nonmember_x) -> dict[str, float]:
+        x, y = attack_dataset(predict_fn, member_x, nonmember_x, self.top_k)
+        logits = self.model.apply(self.variables, x)
+        pred = jnp.argmax(logits, -1)
+        acc = float((pred == y).mean())
+        tpr = float(pred[y == 1].mean()) if int((y == 1).sum()) else 0.0
+        fpr = float(pred[y == 0].mean()) if int((y == 0).sum()) else 0.0
+        return {"attack_acc": acc, "advantage": tpr - fpr, "tpr": tpr, "fpr": fpr}
+
+
+def loss_attack(loss_fn: Callable, member, nonmember) -> dict[str, float]:
+    """Threshold-on-loss attack (reference MI_attack loss attack): predict
+    'member' when loss < t, with t swept for the best advantage."""
+    lm = np.asarray(loss_fn(*member))
+    ln = np.asarray(loss_fn(*nonmember))
+    ts = np.quantile(np.concatenate([lm, ln]), np.linspace(0.05, 0.95, 19))
+    best = {"attack_acc": 0.0, "advantage": -1.0, "threshold": float(ts[0])}
+    for t in ts:
+        tpr = float((lm < t).mean())
+        fpr = float((ln < t).mean())
+        acc = 0.5 * (tpr + (1 - fpr))
+        if tpr - fpr > best["advantage"]:
+            best = {"attack_acc": acc, "advantage": tpr - fpr, "threshold": float(t)}
+    return best
+
+
+def gradient_norm_attack(grad_norm_fn: Callable, member, nonmember) -> dict[str, float]:
+    """Gradient-norm attack (reference mix-gradient attack): members have
+    smaller per-sample gradient norms on a trained model."""
+    gm = np.asarray(grad_norm_fn(*member))
+    gn = np.asarray(grad_norm_fn(*nonmember))
+    ts = np.quantile(np.concatenate([gm, gn]), np.linspace(0.05, 0.95, 19))
+    best = {"attack_acc": 0.0, "advantage": -1.0, "threshold": float(ts[0])}
+    for t in ts:
+        tpr = float((gm < t).mean())
+        fpr = float((gn < t).mean())
+        acc = 0.5 * (tpr + (1 - fpr))
+        if tpr - fpr > best["advantage"]:
+            best = {"attack_acc": acc, "advantage": tpr - fpr, "threshold": float(t)}
+    return best
+
+
+def make_per_sample_loss(trainer, variables):
+    """Per-sample CE through a ModelTrainer (helper for loss_attack)."""
+
+    @jax.jit
+    def f(x, y):
+        logits, _ = trainer.apply(variables, x, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y)
+
+    return f
+
+
+def make_per_sample_grad_norm(trainer, variables):
+    """Per-sample parameter-gradient L2 norms (helper for the gradient attack)."""
+
+    def one(x, y):
+        def loss(params):
+            v = dict(variables)
+            v["params"] = params
+            logits, _ = trainer.apply(v, x[None], train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y[None]).mean()
+
+        g = jax.grad(loss)(variables["params"])
+        return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(g)))
+
+    return jax.jit(jax.vmap(one))

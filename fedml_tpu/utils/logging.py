@@ -1,0 +1,101 @@
+"""Metrics logging — wandb-compatible backbone without the wandb dependency.
+
+The reference's metrics spine is wandb: every main calls wandb.init and
+aggregators log Train/Acc, Train/Loss, Test/Acc, Test/Loss per round
+(reference FedAVGAggregator.py:136-161); CI asserts against
+`wandb/latest-run/files/wandb-summary.json` (CI-script-fedavg.sh:44-50).
+
+MetricsLogger reproduces that contract: per-step history JSONL + a
+`wandb-summary.json` holding the latest value of every key, so the
+reference's CI asserts run unmodified against our runs. If wandb is
+importable and enabled, it mirrors the calls through.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+class MetricsLogger:
+    def __init__(self, run_dir: str = "./wandb/latest-run/files",
+                 project: str | None = None, config: dict | None = None,
+                 use_wandb: bool = False):
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self.summary: dict[str, Any] = {}
+        self._history_path = os.path.join(run_dir, "history.jsonl")
+        self._summary_path = os.path.join(run_dir, "wandb-summary.json")
+        self._t0 = time.time()
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project=project, config=config or {})
+            except Exception as e:  # wandb absent or offline — JSON files only
+                log.warning("wandb unavailable (%s); file-backed metrics only", e)
+        if config:
+            with open(os.path.join(run_dir, "config.json"), "w") as f:
+                json.dump(config, f, indent=2, default=str)
+
+    def log(self, metrics: dict[str, Any], step: int | None = None):
+        rec = dict(metrics)
+        if step is not None:
+            rec["round"] = step
+        rec["_runtime"] = round(time.time() - self._t0, 3)
+        with open(self._history_path, "a") as f:
+            f.write(json.dumps(rec, default=float) + "\n")
+        self.summary.update(rec)
+        with open(self._summary_path, "w") as f:
+            json.dump(self.summary, f, default=float)
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def finish(self):
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+class RoundTimer:
+    """Per-round wall-clock stats (the reference only has ad-hoc time.time()
+    around aggregation, FedAVGAggregator.py:59,85 — SURVEY §5 tracing gap)."""
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._start: float | None = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._start)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    def summary(self) -> dict[str, float]:
+        if not self.times:
+            return {}
+        ts = sorted(self.times)
+        return {
+            "round_time_mean": self.mean,
+            "round_time_p50": ts[len(ts) // 2],
+            "round_time_max": ts[-1],
+            "rounds_per_sec": 1.0 / self.mean if self.mean else 0.0,
+        }
+
+
+def profile_trace(log_dir: str = "/tmp/fedml_tpu_trace"):
+    """jax.profiler trace context for TPU timeline capture (SURVEY §5:
+    reference has no tracing; this exceeds it)."""
+    import jax
+
+    return jax.profiler.trace(log_dir)

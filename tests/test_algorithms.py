@@ -195,3 +195,28 @@ def test_pushsum_on_directed_topology():
     api.run(x, y)
     assert np.isfinite(api.regret())
     assert np.mean(api.loss_history[-5:]) < np.mean(api.loss_history[:5])
+
+
+def test_pushsum_omega_evolves_on_directed_graph():
+    """Regression: with a directed (row-stochastic, not doubly-stochastic) W,
+    push-sum's omega mass must actually evolve (mix = W^T), else push-sum
+    degenerates to biased DSGD."""
+    import jax.numpy as jnp
+    from fedml_tpu.algorithms.decentralized import build_gossip_step
+
+    topo = AsymmetricTopologyManager(6, 3, 3, np.random.RandomState(0))
+    topo.generate_topology()
+    W = jnp.asarray(topo.mixing_matrix())
+    assert float(jnp.max(jnp.abs(W - W.T))) > 1e-6  # genuinely directed
+
+    cfg = FedConfig(lr=0.0)  # isolate the mixing dynamics
+    t = _trainer(2)
+    step = build_gossip_step(t, cfg, push_sum=True)
+    z = jax.vmap(lambda k: t.init(k, jnp.zeros((1, 12))))(
+        jax.random.split(jax.random.PRNGKey(0), 6))
+    batch = {"x": jnp.zeros((6, 1, 12)), "y": jnp.zeros((6, 1), jnp.int32),
+             "mask": jnp.ones((6, 1))}
+    omega = jnp.ones(6)
+    _, omega1, _, _ = step(z["params"], omega, z, batch, W, jax.random.PRNGKey(1))
+    assert float(jnp.max(jnp.abs(omega1 - 1.0))) > 1e-4  # mass moved
+    assert abs(float(omega1.sum()) - 6.0) < 1e-4  # but is conserved

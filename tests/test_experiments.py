@@ -1,0 +1,99 @@
+"""Experiment CLI mains, metrics logging, checkpoint/resume tests."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_main_fedavg_cli(tmp_path):
+    from fedml_tpu.experiments.main_fedavg import main
+
+    hist = main([
+        "--dataset", "mnist", "--model", "lr", "--partition_method", "homo",
+        "--client_num_in_total", "6", "--client_num_per_round", "4",
+        "--comm_round", "3", "--batch_size", "32", "--lr", "0.1",
+        "--run_dir", str(tmp_path / "run"),
+    ])
+    assert len(hist) == 3
+    # wandb-compatible summary written (the reference CI assert source)
+    summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())
+    assert "Test/Acc" in summary and summary["Test/Acc"] > 0.5
+
+
+def test_main_fedopt_cli(tmp_path):
+    from fedml_tpu.experiments.main_fedopt import main
+
+    hist = main([
+        "--dataset", "mnist", "--model", "lr", "--partition_method", "homo",
+        "--client_num_in_total", "6", "--client_num_per_round", "6",
+        "--comm_round", "2", "--batch_size", "32", "--lr", "0.1",
+        "--server_optimizer", "adam", "--server_lr", "0.01",
+        "--run_dir", str(tmp_path / "run"),
+    ])
+    assert len(hist) == 2
+
+
+def test_main_decentralized_cli(tmp_path):
+    from fedml_tpu.experiments.main_decentralized import main
+
+    losses = main(["--client_number", "6", "--iterations", "20",
+                   "--run_dir", str(tmp_path / "run")])
+    assert len(losses) == 20
+    assert np.isfinite(losses[-1])
+
+
+def test_main_base_cli():
+    from fedml_tpu.experiments.main_base import main
+
+    out = main(["--client_num", "4", "--comm_round", "2"])
+    assert out == [0.0 + 1 + 2 + 3, 1.0 + 2 + 3 + 4]
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    """A run interrupted at round 2 of 4 and resumed produces exactly the
+    same global model as an uninterrupted run (SURVEY §5: the reference's
+    FedAvg cannot do this at all)."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.registry import create_model
+
+    ds = load_dataset("mnist", client_num_in_total=6, partition_method="homo", seed=0)
+    cfg = FedConfig(comm_round=4, batch_size=32, lr=0.1,
+                    client_num_in_total=6, client_num_per_round=4)
+
+    def fresh_api():
+        return FedAvgAPI(ds, cfg, ClassificationTrainer(create_model("lr", output_dim=10)))
+
+    straight = fresh_api()
+    straight.train()
+
+    ck = str(tmp_path / "ck")
+    first = fresh_api()
+    for r in range(2):
+        first.train_one_round(r)
+    first.save_checkpoint(ck, 2)
+
+    resumed = fresh_api()
+    resumed.train(ckpt_dir=ck)
+    d = jax.tree.map(lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+                     straight.global_variables, resumed.global_variables)
+    assert max(jax.tree.leaves(d)) < 1e-6
+
+
+def test_metrics_logger_files(tmp_path):
+    from fedml_tpu.utils.logging import MetricsLogger
+
+    lg = MetricsLogger(run_dir=str(tmp_path), config={"lr": 0.1})
+    lg.log({"Test/Acc": 0.5}, step=0)
+    lg.log({"Test/Acc": 0.8}, step=1)
+    summary = json.loads((tmp_path / "wandb-summary.json").read_text())
+    assert summary["Test/Acc"] == 0.8  # latest wins (wandb summary semantics)
+    lines = (tmp_path / "history.jsonl").read_text().strip().split("\n")
+    assert len(lines) == 2
+    assert json.loads((tmp_path / "config.json").read_text())["lr"] == 0.1

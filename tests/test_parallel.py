@@ -98,3 +98,25 @@ def test_zero_count_client_padding_is_noop(mesh8, ds16):
     # and weight-0 padding must leave the weighted mean unchanged vs 6 clients
     d2 = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_pad)
     assert max(jax.tree.leaves(d2)) < 1e-4
+
+
+def test_multihost_helpers_single_process():
+    """Single-process degradation of the cross-silo helpers (the multi-host
+    path needs real multi-process; the API contract is testable here)."""
+    import numpy as np
+
+    from fedml_tpu.parallel.multihost import (
+        allgather_metrics,
+        assert_same_across_processes,
+        broadcast_from_server,
+        init_multihost,
+        round_barrier,
+    )
+
+    info = init_multihost()
+    assert info["process_count"] == 1
+    assert broadcast_from_server(np.arange(3)).tolist() == [0, 1, 2]
+    m = allgather_metrics({"correct": 5.0, "total": 10.0})
+    assert m == {"correct": 5.0, "total": 10.0}
+    assert_same_across_processes(np.ones(2))
+    round_barrier("round", 0)

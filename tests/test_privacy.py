@@ -1,0 +1,165 @@
+"""Privacy package tests: branch ensembles, MI attacks, adversarial eval."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.ensemble import AdaptiveCNN, ArchSpec, build_hetero_archs
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.privacy.branch_fedavg import BranchFedAvgAPI
+
+
+@pytest.fixture(scope="module")
+def mnist8():
+    return load_dataset("mnist", client_num_in_total=8, partition_method="homo", seed=0)
+
+
+def test_adaptive_cnn_variants_forward():
+    x = jnp.zeros((2, 28, 28, 1))
+    for spec in build_hetero_archs(6):
+        m = AdaptiveCNN(output_dim=10, arch=spec)
+        v = m.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+    # hetero archs actually differ
+    descs = {s.describe() for s in build_hetero_archs(6)}
+    assert len(descs) > 1
+
+
+@pytest.mark.parametrize("method", ["predavg", "predvote", "predweight"])
+def test_branch_fedavg_ensembles(mnist8, method):
+    cfg = FedConfig(comm_round=3, batch_size=32, lr=0.1,
+                    client_num_in_total=8, client_num_per_round=8)
+    trainers = [ClassificationTrainer(create_model("lr", output_dim=10)) for _ in range(2)]
+    api = BranchFedAvgAPI(mnist8, cfg, trainers, ensemble_method=method)
+    hist = api.train()
+    assert hist[-1]["Ensemble/Acc"] > 0.5
+    assert hist[-1]["Branch0/Acc"] > 0.4 and hist[-1]["Branch1/Acc"] > 0.4
+
+
+def test_blockavg_shares_blocks(mnist8):
+    cfg = FedConfig(comm_round=2, batch_size=32, lr=0.1,
+                    client_num_in_total=8, client_num_per_round=8)
+    trainers = [ClassificationTrainer(create_model("lr", output_dim=10)) for _ in range(2)]
+    api = BranchFedAvgAPI(mnist8, cfg, trainers, ensemble_method="predavg",
+                          shared_blocks=("linear",))
+    api.train()
+    a = api.branches[0]["params"]["linear"]["kernel"]
+    b = api.branches[1]["params"]["linear"]["kernel"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_hetero_ensemble_branches(mnist8):
+    import dataclasses
+    ds = mnist8
+    # hetero AdaptiveCNN branches need image input
+    ds_img = load_dataset("mnist", client_num_in_total=4, partition_method="homo",
+                          seed=0, flatten=False)
+    from fedml_tpu.data.packing import PackedClients
+    n_cap = 48
+    ds_img = dataclasses.replace(
+        ds_img,
+        train=PackedClients(ds_img.train.x[:, :n_cap], ds_img.train.y[:, :n_cap],
+                            np.minimum(ds_img.train.counts, n_cap)),
+        test_global=(ds_img.test_global[0][:200], ds_img.test_global[1][:200]),
+    )
+    cfg = FedConfig(comm_round=1, batch_size=16, lr=0.05,
+                    client_num_in_total=4, client_num_per_round=4)
+    specs = build_hetero_archs(2)
+    trainers = [ClassificationTrainer(AdaptiveCNN(output_dim=10, arch=s)) for s in specs]
+    api = BranchFedAvgAPI(ds_img, cfg, trainers, ensemble_method="predavg")
+    hist = api.train()
+    assert "Ensemble/Acc" in hist[-1]
+
+
+# ------------------------------------------------------------------ attacks
+
+def _overfit_target(seed=0):
+    """A deliberately-overfit LR target: memorizes its tiny train split."""
+    import optax
+
+    rng = np.random.RandomState(seed)
+    xm = rng.normal(size=(40, 16)).astype(np.float32)
+    ym = rng.randint(0, 2, size=40).astype(np.int32)
+    xn = rng.normal(size=(40, 16)).astype(np.float32)
+    yn = rng.randint(0, 2, size=40).astype(np.int32)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=2))
+    v = trainer.init(jax.random.PRNGKey(0), jnp.asarray(xm[:1]))
+    opt = optax.sgd(0.5)
+    st = opt.init(v["params"])
+
+    @jax.jit
+    def step(p, st):
+        def loss(p):
+            logits, _ = trainer.apply({"params": p}, jnp.asarray(xm), train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, jnp.asarray(ym)).mean()
+
+        g = jax.grad(loss)(p)
+        u, st = opt.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    p = v["params"]
+    for _ in range(300):
+        p, st = step(p, st)
+    return trainer, {"params": p}, (xm, ym), (xn, yn)
+
+
+def test_loss_attack_detects_overfit_membership():
+    from fedml_tpu.privacy.mi_attack import loss_attack, make_per_sample_loss
+
+    trainer, variables, member, nonmember = _overfit_target()
+    f = make_per_sample_loss(trainer, variables)
+    res = loss_attack(f, (jnp.asarray(member[0]), jnp.asarray(member[1])),
+                      (jnp.asarray(nonmember[0]), jnp.asarray(nonmember[1])))
+    assert res["advantage"] > 0.3  # memorized members have much lower loss
+
+
+def test_nn_attack_runs_and_beats_chance():
+    from fedml_tpu.privacy.mi_attack import NNAttack
+
+    trainer, variables, member, nonmember = _overfit_target()
+
+    def predict(x):
+        logits, _ = trainer.apply(variables, x, train=False)
+        return logits
+
+    atk = NNAttack(epochs=20).fit(predict, jnp.asarray(member[0]), jnp.asarray(nonmember[0]))
+    res = atk.score(predict, jnp.asarray(member[0]), jnp.asarray(nonmember[0]))
+    assert res["attack_acc"] > 0.6
+
+
+def test_gradient_norm_attack():
+    from fedml_tpu.privacy.mi_attack import gradient_norm_attack, make_per_sample_grad_norm
+
+    trainer, variables, member, nonmember = _overfit_target()
+    f = make_per_sample_grad_norm(trainer, variables)
+    res = gradient_norm_attack(f, (jnp.asarray(member[0]), jnp.asarray(member[1])),
+                               (jnp.asarray(nonmember[0]), jnp.asarray(nonmember[1])))
+    assert res["advantage"] > 0.3
+
+
+def test_pgd_attack_reduces_accuracy():
+    from fedml_tpu.privacy.adv_attack import robust_accuracy
+
+    ds = load_dataset("mnist", client_num_in_total=4, partition_method="homo", seed=0)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=10))
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    cfg = FedConfig(comm_round=3, batch_size=32, lr=0.1,
+                    client_num_in_total=4, client_num_per_round=4,
+                    frequency_of_the_test=3)
+    api = FedAvgAPI(ds, cfg, trainer)
+    api.train()
+
+    def predict(x):
+        logits, _ = trainer.apply(api.global_variables, x, train=False)
+        return logits
+
+    x = jnp.asarray(ds.test_global[0][:128])
+    y = jnp.asarray(ds.test_global[1][:128])
+    accs = robust_accuracy(predict, x, y, [0.0, 0.5], attack="pgd", steps=5)
+    assert accs[0.0] > 0.8
+    assert accs[0.5] < accs[0.0]  # attack hurts

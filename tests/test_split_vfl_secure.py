@@ -192,3 +192,15 @@ def test_gkt_resnet_shapes():
     sv = sm.init({"params": jax.random.PRNGKey(1)}, feats, train=False)
     out = sm.apply(sv, feats, train=False)
     assert out.shape == (2, 10)
+
+
+def test_secure_aggregator_uniform_weights_no_shrink():
+    """Regression: rounded fixed-point weights that do not sum to 256
+    (e.g. three equal weights -> 3*85=255) must not scale the average."""
+    from fedml_tpu.algorithms.turboaggregate import SecureAggregator
+    import jax.numpy as jnp
+
+    trees = [{"w": jnp.full((4,), float(i + 1))} for i in range(3)]
+    agg = SecureAggregator(num_clients=3, threshold=1, seed=0)
+    out = agg.secure_weighted_sum(trees, np.array([1.0, 1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(4, 2.0), atol=1e-3)
