@@ -115,7 +115,7 @@ class HierarchicalFLAPI:
         history = []
         for r in range(self.cfg.comm_round):
             m = self.train_one_round(r)
-            rec = {"round": r, **self.eval_global()}
+            rec = {"round": r, **m, **self.eval_global()}
             history.append(rec)
         return history
 

@@ -127,6 +127,9 @@ class SplitNNAPI:
                 batch = {"x": jnp.take(x, idx, 0), "y": jnp.take(y, idx, 0),
                          "mask": m.astype(jnp.float32)}
                 cp, sp, co, so, metrics = step(cp, sp, co, so, batch)
+                # per-sample semantics: weight the batch-mean loss by its real
+                # (unpadded) sample count so epoch sums normalize by `total`
+                metrics = dict(metrics, loss=metrics["loss"] * batch["mask"].sum())
                 return (cp, sp, co, so), metrics
 
             (cp, sp, co, so), ms = jax.lax.scan(body, (cp, sp, co, so), (bidx, bmask))
@@ -162,7 +165,7 @@ class SplitNNAPI:
             self.history.append({
                 "round": cycle,
                 "Train/Acc": correct / max(total, 1.0),
-                "Train/Loss": loss / max(self.dataset.client_num * cfg.epochs, 1),
+                "Train/Loss": loss / max(total, 1.0),
             })
         return self.history
 

@@ -66,6 +66,32 @@ def gen_lagrange_coeffs(alpha_s: np.ndarray, beta_s: np.ndarray, p: int) -> np.n
     return U
 
 
+def _mod_matmul(A: np.ndarray, B: np.ndarray, p: int) -> np.ndarray:
+    """(A @ B) mod p without int64 overflow.
+
+    Both operands are reduced mod p (< 2^31), then A is split into 16-bit
+    limbs: every partial product stays below 2^47, so sums over up to ~2^16
+    terms fit comfortably in int64. A naive int64 A @ B with full-range field
+    elements wraps mod 2^64 once two ~2^62 products are summed, which is NOT
+    congruent mod p (silent corruption when decoding from a non-aligned share
+    subset).
+    """
+    A = np.mod(np.asarray(A, np.int64), p)
+    B = np.mod(np.asarray(B, np.int64), p)
+    hi = np.mod((A >> 16) @ B, p)
+    lo = np.mod((A & 0xFFFF) @ B, p)
+    return np.mod((hi << 16) + lo, p)
+
+
+def _mod_tensordot(A: np.ndarray, B: np.ndarray, p: int) -> np.ndarray:
+    """tensordot(A, B, axes=(1, 0)) mod p via overflow-safe _mod_matmul.
+    A: [n, k], B: [k, ...] -> [n, ...]."""
+    B = np.asarray(B, np.int64)
+    flat = B.reshape(B.shape[0], -1)
+    out = _mod_matmul(A, flat, p)
+    return out.reshape((A.shape[0],) + B.shape[1:])
+
+
 def _poly_eval_matrix(alpha_s: np.ndarray, degree: int, p: int) -> np.ndarray:
     """Vandermonde [len(alpha), degree+1] with powers mod p."""
     V = np.ones((len(alpha_s), degree + 1), np.int64)
@@ -85,9 +111,8 @@ def bgw_encoding(X: np.ndarray, N: int, T: int, p: int = DEFAULT_PRIME,
     R[0] = X
     alpha_s = np.mod(np.arange(1, N + 1, dtype=np.int64), p)
     V = _poly_eval_matrix(alpha_s, T, p)  # [N, T+1]
-    # share_i = sum_t V[i,t] * R[t]  (mod p) — one big matmul
-    shares = np.mod(np.tensordot(V, np.mod(R, p), axes=(1, 0)), p)
-    return shares.astype(np.int64)
+    # share_i = sum_t V[i,t] * R[t]  (mod p) — one big overflow-safe matmul
+    return _mod_tensordot(V, R, p)
 
 
 def bgw_decoding(f_eval: np.ndarray, worker_idx: list[int], p: int = DEFAULT_PRIME) -> np.ndarray:
@@ -119,8 +144,7 @@ def lcc_encoding(X: np.ndarray, N: int, K: int, T: int, p: int = DEFAULT_PRIME,
     beta_s = np.mod(np.arange(-(n_beta // 2), -(n_beta // 2) + n_beta, dtype=np.int64), p)
     alpha_s = np.mod(np.arange(-(N // 2), -(N // 2) + N, dtype=np.int64), p)
     U = gen_lagrange_coeffs(alpha_s, beta_s, p)  # [N, K+T]
-    enc = np.mod(np.tensordot(U, sub, axes=(1, 0)), p)
-    return enc.astype(np.int64)
+    return _mod_tensordot(U, sub, p)
 
 
 def lcc_decoding(f_eval: np.ndarray, eval_points: np.ndarray, K: int, T: int,
@@ -130,7 +154,7 @@ def lcc_decoding(f_eval: np.ndarray, eval_points: np.ndarray, K: int, T: int,
     beta_s = np.mod(np.arange(-(n_beta // 2), -(n_beta // 2) + n_beta, dtype=np.int64), p)
     U = gen_lagrange_coeffs(beta_s[:K], np.mod(eval_points, p), p)  # [K, n_eval]
     flat = f_eval.reshape(len(eval_points), -1)
-    out = np.mod(U @ np.mod(flat, p), p)
+    out = _mod_matmul(U, flat, p)
     return out.reshape((K,) + f_eval.shape[1:])
 
 
@@ -180,11 +204,38 @@ class SecureAggregator:
         w = np.asarray(weights, np.float64)
         w = w / w.sum()
         # weight in fixed point too: scale each client's quantized vec by w_i
-        # (integer mult in the field keeps linearity of the sharing)
-        wq = np.round(w * (1 << 8)).astype(np.int64)  # 8-bit weight resolution
+        # (integer mult in the field keeps linearity of the sharing). Start at
+        # 8-bit resolution; if any client's weight would round to 0 (and be
+        # silently dropped from the secure sum), raise the resolution until it
+        # doesn't, bounded by the field-overflow budget below.
+        nonzero = w > 0  # exactly-zero weights contribute nothing; that's fine
+        for res_bits in range(8, 22, 2):
+            wq = np.round(w * (1 << res_bits)).astype(np.int64)
+            if not nonzero.any() or wq[nonzero].min() > 0:
+                break
+        else:
+            raise ValueError(
+                f"client weight {w[nonzero].min():.3g} underflows fixed-point "
+                f"resolution 2^-{res_bits}; weights this skewed cannot be "
+                "represented — drop the client or rescale weights"
+            )
+        # quantize once up front; the signed magnitudes double as the overflow
+        # budget: the reconstructed signed sum must stay in (-p/2, p/2) or
+        # dequantize_vector aliases. Each client knows its own max |q|.
+        qvecs = [quantize_tree(tree, self.frac_bits, self.p) for tree in client_trees]
+        bound = 0
+        for vec, wi in zip(qvecs, wq):
+            signed_max = int(np.max(np.where(vec > self.p // 2, self.p - vec, vec),
+                                    initial=0))
+            bound += int(wi) * signed_max
+        if bound >= self.p // 2:
+            raise ValueError(
+                f"weighted fixed-point sum bound {bound} exceeds field capacity "
+                f"{self.p // 2}; reduce frac_bits ({self.frac_bits}) or weight "
+                f"resolution (2^{res_bits})"
+            )
         share_sum = None
-        for tree, wi in zip(client_trees, wq):
-            vec = quantize_tree(tree, self.frac_bits, self.p)
+        for vec, wi in zip(qvecs, wq):
             masked = np.mod(vec * wi, self.p)[None, :]  # [1, n]
             shares = bgw_encoding(masked.T, self.n, self.t, self.p, self.rng)  # [N, n, 1]
             share_sum = shares if share_sum is None else np.mod(share_sum + shares, self.p)

@@ -32,13 +32,22 @@ def save_checkpoint(ckpt_dir: str, step: int, state: dict[str, Any],
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.abspath(path), _to_numpy(state["tree"]), force=True)
         ckptr.wait_until_finished()
+        backend = "orbax"
     except Exception:
         leaves, treedef = jax.tree.flatten(_to_numpy(state["tree"]))
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "leaves.npz"),
                  **{f"leaf_{i}": l for i, l in enumerate(leaves)})
-    with open(os.path.join(ckpt_dir, f"meta_{step}.json"), "w") as f:
-        json.dump({"step": step, "meta": state.get("meta", {})}, f, default=float)
+        backend = "npz"
+    # meta last + atomic rename: all_checkpoint_steps only ever sees steps
+    # whose tree save completed. Backend recorded so restore can dispatch
+    # instead of masking backend skew as a missing-leaves.npz error.
+    meta_path = os.path.join(ckpt_dir, f"meta_{step}.json")
+    tmp_path = meta_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump({"step": step, "backend": backend,
+                   "meta": state.get("meta", {})}, f, default=float)
+    os.replace(tmp_path, meta_path)
     # retention
     steps = sorted(all_checkpoint_steps(ckpt_dir))
     for s in steps[:-keep]:
@@ -69,15 +78,22 @@ def restore_checkpoint(ckpt_dir: str, example_tree, step: int | None = None):
         return None
     step = steps[-1] if step is None else step
     path = os.path.join(ckpt_dir, f"ckpt_{step}")
-    try:
-        import orbax.checkpoint as ocp
-
-        ckptr = ocp.StandardCheckpointer()
-        tree = ckptr.restore(os.path.abspath(path), _to_numpy(example_tree))
-    except Exception:
+    with open(os.path.join(ckpt_dir, f"meta_{step}.json")) as f:
+        meta = json.load(f)
+    backend = meta.get("backend")
+    if backend == "npz" or (backend is None
+                            and os.path.exists(os.path.join(path, "leaves.npz"))):
         data = np.load(os.path.join(path, "leaves.npz"))
         leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
         tree = jax.tree.unflatten(jax.tree.structure(example_tree), leaves)
-    with open(os.path.join(ckpt_dir, f"meta_{step}.json")) as f:
-        meta = json.load(f)
+    else:
+        try:
+            import orbax.checkpoint as ocp
+        except Exception as e:
+            raise RuntimeError(
+                f"checkpoint at {path} was saved with orbax but orbax is not "
+                "importable here — install orbax or re-save with the npz backend"
+            ) from e
+        ckptr = ocp.StandardCheckpointer()
+        tree = ckptr.restore(os.path.abspath(path), _to_numpy(example_tree))
     return tree, step, meta.get("meta", {})
