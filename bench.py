@@ -1,11 +1,18 @@
-"""Benchmark: FedAvg rounds/sec + samples/sec/chip on the flagship workload.
+"""Benchmark: FedAvg rounds/sec + samples/sec/chip.
 
-Workload mirrors the reference's FEMNIST north star (BASELINE.md: 3400
-clients, 10 clients/round, CNN_DropOut, bs 20, E=1, SGD lr 0.1 — reference
-benchmark/README.md:56-59) with FEMNIST-shaped data (~200 samples/client).
+Two workloads (BENCH_WORKLOAD env):
+  flagship (default) — mirrors the reference's FEMNIST north star
+    (BASELINE.md: 3400 clients, 10 clients/round, CNN_DropOut, bs 20, E=1,
+    SGD lr 0.1 — reference benchmark/README.md:56-59) with FEMNIST-shaped
+    data (~200 samples/client).
+  cross_silo — the BASELINE.md cross-silo table: CIFAR-10-shaped data,
+    ResNet-56, 10 silos, bs 64 (reference benchmark/README.md:103-112),
+    where arithmetic intensity is high enough for MFU to be meaningful.
+
 The reference publishes no throughput numbers (BASELINE.json "published": {}),
 so vs_baseline is null unless a reference measurement is provided via
-BENCH_REF_SAMPLES_PER_SEC_PER_CHIP.
+BENCH_REF_SAMPLES_PER_SEC_PER_CHIP. See docs/PERF.md for the profile and
+roofline analysis behind these configs.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -15,6 +22,12 @@ import os
 import time
 
 import numpy as np
+
+WORKLOADS = {
+    # name: (model, output_dim, input_shape, samples/client, batch, clients)
+    "flagship": ("cnn", 62, (28, 28, 1), 200, 20, 10),
+    "cross_silo": ("resnet56", 10, (32, 32, 3), 256, 64, 10),
+}
 
 
 def main():
@@ -27,10 +40,12 @@ def main():
     from fedml_tpu.core.trainer import ClassificationTrainer
     from fedml_tpu.models.registry import create_model
 
-    clients_per_round = int(os.environ.get("BENCH_CLIENTS_PER_ROUND", 10))
-    n_per_client = int(os.environ.get("BENCH_SAMPLES_PER_CLIENT", 200))
+    workload = os.environ.get("BENCH_WORKLOAD", "flagship")
+    model_name, out_dim, in_shape, d_n, d_bs, d_cpr = WORKLOADS[workload]
+    clients_per_round = int(os.environ.get("BENCH_CLIENTS_PER_ROUND", d_cpr))
+    n_per_client = int(os.environ.get("BENCH_SAMPLES_PER_CLIENT", d_n))
     epochs = int(os.environ.get("BENCH_EPOCHS", 1))
-    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 20))
+    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", d_bs))
     timed_rounds = int(os.environ.get("BENCH_ROUNDS", 60))
 
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")  # MXU-native default
@@ -38,7 +53,7 @@ def main():
         batch_size=batch_size, epochs=epochs, lr=0.1, client_optimizer="sgd",
         client_num_per_round=clients_per_round, dtype=dtype,
     )
-    trainer = ClassificationTrainer(create_model("cnn", output_dim=62, dtype=dtype))
+    trainer = ClassificationTrainer(create_model(model_name, output_dim=out_dim, dtype=dtype))
     agg = make_aggregator("fedavg", cfg)
     n_chips = jax.device_count()
     if n_chips > 1:
@@ -51,8 +66,8 @@ def main():
         round_fn = build_round_fn(trainer, cfg, agg)
 
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(clients_per_round, n_per_client, 28, 28, 1).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 62, size=(clients_per_round, n_per_client)).astype(np.int32))
+    x = jnp.asarray(rng.rand(clients_per_round, n_per_client, *in_shape).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, out_dim, size=(clients_per_round, n_per_client)).astype(np.int32))
     counts = jnp.asarray(np.full(clients_per_round, n_per_client, np.int32))
 
     key = jax.random.PRNGKey(0)
@@ -97,8 +112,12 @@ def main():
     ref = os.environ.get("BENCH_REF_SAMPLES_PER_SEC_PER_CHIP")
     vs_baseline = samples_per_sec_per_chip / float(ref) if ref else None
 
+    metric_name = {
+        "flagship": "fedavg_femnist_cnn_samples_per_sec_per_chip",
+        "cross_silo": "fedavg_cifar_resnet56_samples_per_sec_per_chip",
+    }[workload]
     print(json.dumps({
-        "metric": "fedavg_femnist_cnn_samples_per_sec_per_chip",
+        "metric": metric_name,
         "value": round(samples_per_sec_per_chip, 2),
         "unit": "samples/s/chip",
         "vs_baseline": vs_baseline,

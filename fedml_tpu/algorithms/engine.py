@@ -79,6 +79,14 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
         raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
     opt = make_local_optimizer(cfg)
     mu = cfg.fedprox_mu
+    # Stateless-optimizer fast path: with plain SGD (no momentum/wd) a zero
+    # gradient IS a no-op update — masked losses give exactly-zero grads on
+    # all-padding batches (mask is a constant factor of the loss), so the
+    # per-leaf tree_where select machinery is dead weight. The round profile
+    # is tiny-op latency-bound (~56 ops/step at ~20us), so dropping ~2 selects
+    # per param leaf per step is a real win; model state (e.g. BatchNorm
+    # running stats) is still masked because padded samples DO pollute it.
+    stateless_opt = cfg.client_optimizer == "sgd" and not cfg.momentum and not cfg.wd
 
     def local_update(global_variables, x, y, count, rng) -> LocalResult:
         n_max = x.shape[0]
@@ -96,15 +104,19 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
             perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
             if n_pad > n_max:
                 perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
-            batch_idx = perm.reshape(nb, b)
+            # ONE epoch-level gather instead of a gather per step: scan then
+            # slices contiguous batches from the pre-permuted copy (dispatch-
+            # latency-bound regime — fewer, larger ops win).
+            xe = jnp.take(x, perm, axis=0).reshape((nb, b) + x.shape[1:])
+            ye = jnp.take(y, perm, axis=0).reshape((nb, b) + y.shape[1:])
             batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
 
             def step_body(carry, scan_in):
                 variables, opt_state, steps = carry
-                idx, bvalid, srng = scan_in
+                bx, by, bvalid, srng = scan_in
                 batch = {
-                    "x": jnp.take(x, idx, axis=0),
-                    "y": jnp.take(y, idx, axis=0),
+                    "x": bx,
+                    "y": by,
                     "mask": bvalid.astype(jnp.float32),
                 }
 
@@ -126,15 +138,25 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
                 updates, new_opt_state = opt.update(grads, opt_state, variables["params"])
                 new_params = optax.apply_updates(variables["params"], updates)
                 has_data = jnp.any(bvalid)
-                new_vars = _merge_variables(variables, new_params, new_state)
-                variables = tree_where(has_data, new_vars, variables)
-                opt_state = tree_where(has_data, new_opt_state, opt_state)
+                if stateless_opt:
+                    # zero grads already make the update a no-op; only guard
+                    # mutable model state (BN stats) against padded samples
+                    variables = _merge_variables(
+                        variables, new_params,
+                        tree_where(has_data, new_state,
+                                   {k: variables[k] for k in new_state}),
+                    )
+                    opt_state = new_opt_state
+                else:
+                    new_vars = _merge_variables(variables, new_params, new_state)
+                    variables = tree_where(has_data, new_vars, variables)
+                    opt_state = tree_where(has_data, new_opt_state, opt_state)
                 steps = steps + has_data.astype(jnp.int32)
                 return (variables, opt_state, steps), aux
 
             srngs = jax.random.split(step_rng, nb)
             (variables, opt_state, steps), auxs = jax.lax.scan(
-                step_body, (variables, opt_state, steps), (batch_idx, batch_valid, srngs)
+                step_body, (variables, opt_state, steps), (xe, ye, batch_valid, srngs)
             )
             return (variables, opt_state, steps), auxs
 
