@@ -1,19 +1,34 @@
 """FedGKT — group knowledge transfer split training, TPU-native.
 
-Behavior-parity rebuild of reference fedml_api/distributed/fedgkt/
-(GKTClientTrainer.py:49-128: edge CNN trains with CE + alpha*KL against
-server logits, then exports per-batch feature maps; GKTServerTrainer.py:193-291:
-server trains the large model on client features with CE + alpha*KL against
-client logits, returns per-client server logits; losses utils.py:75-113).
+Behavior-parity rebuild of reference fedml_api/distributed/fedgkt/:
+  * client: `epochs_client` epochs of **minibatch** SGD/Adam, loss
+    CE + alpha * KL(server logits) (GKTClientTrainer.py:62-92), then
+    feature/logit extraction for every local sample (:105-121);
+  * server: per round, `epochs_server` epochs of **minibatch** steps over
+    every (client, batch) feature chunk with its own persistent optimizer,
+    loss KL(client logits) + alpha * CE (GKTServerTrainer.py:234-271), with
+    the round-indexed epoch schedule of get_server_epoch_strategy
+    (GKTServerTrainer.py:166-192);
+  * losses: temperature-scaled KL + CE (utils.py:75-113).
 
-The reference ships feature dicts over MPI; here features live as padded
-device arrays per client and both training phases are jitted scans. The KD
-losses follow the reference exactly: KL(student || teacher) with temperature
-T, scaled by T^2.
+TPU-first deviations (semantics preserved, memory/dispatch improved):
+  * features/logits are padded per-sample arrays [C, n_max, ...] instead of
+    python dicts of numpy batches shipped over MPI; server logits are
+    indexed by sample, so client batch shuffling cannot misalign them
+    (the reference aligns by batch_idx and never reshuffles);
+  * both phases are jitted lax.scans over batches — one XLA program per
+    phase; per-step live memory is one batch of features, not the whole
+    federation (the reference's "256G CPU host memory" warning,
+    GKTClientTrainer.py:97-104, does not apply);
+  * server logits are recomputed in one forward sweep after the server
+    epochs rather than captured mid-epoch (the reference reuses the
+    last-epoch training-mode outputs).
 """
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import Any
 
 import jax
@@ -23,10 +38,11 @@ import optax
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
+from fedml_tpu.utils.pytree import tree_where
 
 
 def kd_kl_loss(student_logits, teacher_logits, T: float = 1.0):
-    """T^2 * KL(softmax(teacher/T) || log_softmax(student/T)), batch-mean
+    """T^2 * KL(softmax(teacher/T) || log_softmax(student/T)), per-sample
     (reference KL_Loss, utils.py:75-94; the +1e-7 regularizer included)."""
     s = jax.nn.log_softmax(student_logits / T, axis=-1)
     t = jax.nn.softmax(teacher_logits / T, axis=-1) + 1e-7
@@ -34,20 +50,81 @@ def kd_kl_loss(student_logits, teacher_logits, T: float = 1.0):
     return T * T * per
 
 
+def get_server_epoch_strategy(round_idx: int) -> tuple[int, bool]:
+    """Round-indexed server epoch schedule (GKTServerTrainer.py:166-192
+    strategy "2": more epochs early, distillation switched off late)."""
+    if round_idx < 20:
+        return 20, True
+    if round_idx < 30:
+        return 15, True
+    if round_idx < 40:
+        return 10, True
+    if round_idx < 50:
+        return 8, True
+    if round_idx < 100:
+        return 5, True
+    if round_idx < 150:
+        return 3, True
+    if round_idx <= 200:
+        return 1, False
+    return 1, False
+
+
+def _make_gkt_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+    """SGD(momentum=.9, nesterov, wd) or Adam(amsgrad, wd=1e-4) — the two
+    optimizers both GKT trainers construct (GKTClientTrainer.py:31-37)."""
+    if cfg.client_optimizer == "sgd":
+        chain = []
+        if cfg.wd:
+            chain.append(optax.add_decayed_weights(cfg.wd))
+        chain.append(optax.sgd(cfg.lr, momentum=0.9, nesterov=True))
+        return optax.chain(*chain)
+    return optax.chain(optax.add_decayed_weights(1e-4), optax.amsgrad(cfg.lr))
+
+
+def _epoch_batches(x, y, extra, count, b, rng):
+    """Shuffle the valid prefix and slice [nb, b, ...] batches (engine.py's
+    argsort-of-uniform DataLoader(shuffle=True) parity trick). `extra` is an
+    optional per-sample array (server logits) permuted identically."""
+    n_max = x.shape[0]
+    nb = math.ceil(n_max / b)
+    n_pad = nb * b
+    u = jax.random.uniform(rng, (n_max,))
+    valid = jnp.arange(n_max) < count
+    perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+    if n_pad > n_max:
+        perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
+    xe = jnp.take(x, perm, axis=0).reshape((nb, b) + x.shape[1:])
+    ye = jnp.take(y, perm, axis=0).reshape((nb, b) + y.shape[1:])
+    ee = jnp.take(extra, perm, axis=0).reshape((nb, b) + extra.shape[1:])
+    bvalid = (jnp.take(valid, perm) if n_pad == n_max
+              else jnp.concatenate([jnp.take(valid, perm[:n_max]),
+                                    jnp.zeros(n_pad - n_max, bool)]))
+    return xe, ye, ee, bvalid.reshape(nb, b)
+
+
 class FedGKTAPI:
     """Alternating edge/server knowledge transfer (reference FedGKTAPI.py:16).
 
     client_module(x) -> (logits, features); server_module(features) -> logits.
+    Both optimizers persist across rounds, as the reference's do (created once
+    in each trainer's __init__).
     """
 
     def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
                  client_module, server_module, alpha: float = 1.0,
-                 temperature: float = 3.0, server_epochs: int = 1):
+                 temperature: float = 3.0, server_epochs: int = 1,
+                 use_epoch_schedule: bool = False,
+                 distill_on_server: bool = True,
+                 train_on_client: bool = True):
         self.dataset = dataset
         self.cfg = cfg
         self.alpha = alpha
         self.T = temperature
         self.server_epochs = server_epochs
+        self.use_epoch_schedule = use_epoch_schedule
+        self.distill_on_server = distill_on_server
+        self.train_on_client = train_on_client
         self.client_module = client_module
         self.server_module = server_module
 
@@ -63,8 +140,8 @@ class FedGKTAPI:
         self.server_vars = server_module.init(
             {"params": jax.random.fold_in(rng, 1)}, feat, train=False
         )
-        self.c_opt = optax.sgd(cfg.lr, momentum=0.9)
-        self.s_opt = optax.sgd(cfg.lr, momentum=0.9)
+        self.c_opt = _make_gkt_optimizer(cfg)
+        self.s_opt = _make_gkt_optimizer(cfg)
         self.client_opt_states = jax.vmap(
             lambda k: self.c_opt.init(
                 client_module.init({"params": k}, example, train=False)["params"])
@@ -72,93 +149,177 @@ class FedGKTAPI:
         self.server_opt_state = self.s_opt.init(self.server_vars["params"])
         self._build()
         self.history: list[dict[str, Any]] = []
+        self.server_loss_history: list[float] = []  # per-epoch server losses
+
+    def _batch_size(self, n_max: int) -> int:
+        b = self.cfg.batch_size
+        return n_max if b <= 0 else min(b, n_max)
 
     def _build(self):
         cfg, alpha, T = self.cfg, self.alpha, self.T
         cm, sm = self.client_module, self.server_module
 
-        def client_phase(cvars, copt, x, y, mask, server_logits, have_server, rng):
-            """cfg.epochs of local CE+KD training, then feature extraction.
-            x: [n, ...] padded; server_logits: [n, classes]."""
+        def client_phase(cvars, copt, x, y, count, server_logits, have_server, rng):
+            """epochs_client epochs of minibatched CE+KD local training
+            (GKTClientTrainer.py:62-92), then full-sample feature export."""
+            n_max = x.shape[0]
+            b = self._batch_size(n_max)
             mutable = [k for k in cvars if k != "params"]
 
-            def loss_fn(params, state):
+            def loss_fn(params, state, bx, by, bsl, bmask, srng):
                 v = dict(state); v["params"] = params
                 if mutable:
                     (logits, _), new_state = cm.apply(
-                        v, x, train=True, rngs={"dropout": rng}, mutable=mutable
+                        v, bx, train=True, rngs={"dropout": srng}, mutable=mutable
                     )
                 else:
-                    logits, _ = cm.apply(v, x, train=True, rngs={"dropout": rng})
+                    logits, _ = cm.apply(v, bx, train=True, rngs={"dropout": srng})
                     new_state = {}
-                ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-                kd = kd_kl_loss(logits, server_logits, T)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+                kd = kd_kl_loss(logits, bsl, T)
                 per = ce + alpha * jnp.where(have_server, kd, 0.0)
-                return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0), dict(new_state)
+                m = bmask.astype(jnp.float32)
+                return (per * m).sum() / jnp.maximum(m.sum(), 1.0), dict(new_state)
 
-            params = cvars["params"]
-            state = {k: v for k, v in cvars.items() if k != "params"}
-            for _ in range(cfg.epochs):  # small unrolled loop (epochs is static)
-                (_, state), g = jax.value_and_grad(loss_fn, has_aux=True)(params, state)
-                upd, copt = self.c_opt.update(g, copt, params)
-                params = optax.apply_updates(params, upd)
-            cvars = dict(state); cvars["params"] = params
+            def epoch_body(carry, erng):
+                cvars, copt = carry
+                shuffle_rng, step_rng = jax.random.split(erng)
+                xe, ye, se, bvalid = _epoch_batches(x, y, server_logits, count, b, shuffle_rng)
+                nb = xe.shape[0]
+
+                def step_body(carry, scan_in):
+                    cvars, copt = carry
+                    bx, by, bsl, bv, srng = scan_in
+                    params = cvars["params"]
+                    state = {k: v for k, v in cvars.items() if k != "params"}
+                    (loss, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                        params, state, bx, by, bsl, bv, srng)
+                    upd, new_copt = self.c_opt.update(g, copt, params)
+                    new_params = optax.apply_updates(params, upd)
+                    new_vars = dict(new_state); new_vars["params"] = new_params
+                    has_data = jnp.any(bv)
+                    cvars2 = tree_where(has_data, new_vars, cvars)
+                    copt2 = tree_where(has_data, new_copt, copt)
+                    return (cvars2, copt2), loss
+
+                (cvars, copt), losses = jax.lax.scan(
+                    step_body, (cvars, copt),
+                    (xe, ye, se, bvalid, jax.random.split(step_rng, nb)))
+                return (cvars, copt), losses.mean()
+
+            if self.train_on_client:
+                (cvars, copt), _ = jax.lax.scan(
+                    epoch_body, (cvars, copt), jax.random.split(rng, cfg.epochs))
             logits, feats = cm.apply(cvars, x, train=False)
             return cvars, copt, logits, feats
 
-        def server_phase(svars, sopt, feats, y, mask, client_logits, rng):
-            """feats: [C, n, ...] all clients' features; CE + KD on each."""
+        def server_epoch(svars, sopt, xb, yb, cb, mb, distill, rng):
+            """One server epoch: a grad step per (client, batch) feature chunk
+            (GKTServerTrainer.py:246-271). xb: [NB, b, ...feat]."""
             mutable = [k for k in svars if k != "params"]
-            ff = feats.reshape((-1,) + feats.shape[2:])
 
-            def loss_fn(params, state):
+            def loss_fn(params, state, bf, by, bcl, bm, srng):
                 v = dict(state); v["params"] = params
                 if mutable:
                     logits, new_state = sm.apply(
-                        v, ff, train=True, rngs={"dropout": rng}, mutable=mutable
-                    )
+                        v, bf, train=True, rngs={"dropout": srng}, mutable=mutable)
                 else:
-                    logits = sm.apply(v, ff, train=True, rngs={"dropout": rng})
+                    logits = sm.apply(v, bf, train=True, rngs={"dropout": srng})
                     new_state = {}
-                yf = y.reshape(-1)
-                cf = client_logits.reshape((-1, client_logits.shape[-1]))
-                mf = mask.reshape(-1)
-                ce = optax.softmax_cross_entropy_with_integer_labels(logits, yf)
-                kd = kd_kl_loss(logits, cf, T)
-                per = ce + alpha * kd
-                return (per * mf).sum() / jnp.maximum(mf.sum(), 1.0), dict(new_state)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+                kd = kd_kl_loss(logits, bcl, T)
+                per = jnp.where(distill, kd + alpha * ce, ce)
+                m = bm.astype(jnp.float32)
+                return (per * m).sum() / jnp.maximum(m.sum(), 1.0), dict(new_state)
 
-            params = svars["params"]
-            state = {k: v for k, v in svars.items() if k != "params"}
-            for _ in range(self.server_epochs):
-                (_, state), g = jax.value_and_grad(loss_fn, has_aux=True)(params, state)
-                upd, sopt = self.s_opt.update(g, sopt, params)
-                params = optax.apply_updates(params, upd)
-            svars = dict(state); svars["params"] = params
-            logits = sm.apply(svars, ff, train=False)
-            return svars, sopt, logits.reshape(feats.shape[:2] + (logits.shape[-1],))
+            def step_body(carry, scan_in):
+                svars, sopt = carry
+                bf, by, bcl, bm, srng = scan_in
+                params = svars["params"]
+                state = {k: v for k, v in svars.items() if k != "params"}
+                (loss, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, state, bf, by, bcl, bm, srng)
+                upd, new_sopt = self.s_opt.update(g, sopt, params)
+                new_params = optax.apply_updates(params, upd)
+                new_vars = dict(new_state); new_vars["params"] = new_params
+                has_data = jnp.any(bm)
+                svars2 = tree_where(has_data, new_vars, svars)
+                sopt2 = tree_where(has_data, new_sopt, sopt)
+                return (svars2, sopt2), loss
 
-        self._client_phase = jax.jit(jax.vmap(client_phase, in_axes=(0, 0, 0, 0, 0, 0, None, 0)))
-        self._server_phase = jax.jit(server_phase)
+            nbatches = xb.shape[0]
+            (svars, sopt), losses = jax.lax.scan(
+                step_body, (svars, sopt),
+                (xb, yb, cb, mb, jax.random.split(rng, nbatches)))
+            # mean loss over batches that had data
+            has = jnp.any(mb, axis=tuple(range(1, mb.ndim)))
+            mean_loss = (losses * has).sum() / jnp.maximum(has.sum(), 1)
+            return svars, sopt, mean_loss
+
+        @functools.partial(jax.jit, static_argnames=("epochs",))
+        def server_phase(svars, sopt, feats, y, mask, client_logits, distill, rng, epochs):
+            """epochs of minibatch server training over all clients' feature
+            chunks, then a full logit sweep for the next client round."""
+            C, n = feats.shape[:2]
+            b = self._batch_size(n)
+            nb = math.ceil(n / b)
+            n_pad = nb * b
+
+            def chunk(a):
+                if n_pad > n:
+                    pad = [(0, 0), (0, n_pad - n)] + [(0, 0)] * (a.ndim - 2)
+                    a = jnp.pad(a, pad)
+                return a.reshape((C * nb, b) + a.shape[2:])
+
+            xb, yb, cb, mb = chunk(feats), chunk(y), chunk(client_logits), chunk(mask)
+
+            def epoch_body(carry, erng):
+                svars, sopt = carry
+                svars, sopt, loss = server_epoch(svars, sopt, xb, yb, cb, mb, distill, erng)
+                return (svars, sopt), loss
+
+            (svars, sopt), epoch_losses = jax.lax.scan(
+                epoch_body, (svars, sopt), jax.random.split(rng, epochs))
+
+            # logit sweep for next round's client KD targets (batched scan —
+            # one batch of features live at a time)
+            def fwd(_, bf):
+                return None, sm.apply(svars, bf, train=False)
+            _, lb = jax.lax.scan(fwd, None, xb)
+            server_logits = lb.reshape(C, n_pad, -1)[:, :n]
+            return svars, sopt, server_logits, epoch_losses
+
+        self._client_phase = jax.jit(jax.vmap(
+            client_phase, in_axes=(0, 0, 0, 0, 0, 0, None, 0)))
+        self._server_phase = server_phase
+
+    def train_one_round(self, r: int, x, y, counts, mask, server_logits, key):
+        rngs = jax.random.split(jax.random.fold_in(key, r), self.dataset.client_num)
+        self.client_vars, self.client_opt_states, client_logits, feats = self._client_phase(
+            self.client_vars, self.client_opt_states, x, y, counts, server_logits,
+            jnp.bool_(r > 0), rngs,
+        )
+        if self.use_epoch_schedule:
+            epochs, distill = get_server_epoch_strategy(r)
+        else:
+            epochs, distill = self.server_epochs, self.distill_on_server
+        self.server_vars, self.server_opt_state, server_logits, epoch_losses = self._server_phase(
+            self.server_vars, self.server_opt_state, feats, y, mask, client_logits,
+            jnp.bool_(distill), jax.random.fold_in(key, 10_000 + r), epochs=epochs,
+        )
+        self.server_loss_history.extend(np.asarray(epoch_losses).tolist())
+        return server_logits
 
     def train(self) -> list[dict[str, Any]]:
         ds, cfg = self.dataset, self.cfg
         x = jnp.asarray(ds.train.x)
         y = jnp.asarray(ds.train.y)
-        mask = (jnp.arange(ds.train.n_max)[None, :] < jnp.asarray(ds.train.counts)[:, None]).astype(jnp.float32)
-        n_classes = ds.class_num
-        server_logits = jnp.zeros((ds.client_num, ds.train.n_max, n_classes))
+        counts = jnp.asarray(ds.train.counts)
+        mask = (jnp.arange(ds.train.n_max)[None, :] < counts[:, None]).astype(jnp.float32)
+        server_logits = jnp.zeros((ds.client_num, ds.train.n_max, ds.class_num))
         key = jax.random.PRNGKey(cfg.seed)
         for r in range(cfg.comm_round):
-            rngs = jax.random.split(jax.random.fold_in(key, r), ds.client_num)
-            self.client_vars, self.client_opt_states, client_logits, feats = self._client_phase(
-                self.client_vars, self.client_opt_states, x, y, mask, server_logits,
-                jnp.bool_(r > 0), rngs,
-            )
-            self.server_vars, self.server_opt_state, server_logits = self._server_phase(
-                self.server_vars, self.server_opt_state, feats, y, mask, client_logits,
-                jax.random.fold_in(key, 10_000 + r),
-            )
+            server_logits = self.train_one_round(r, x, y, counts, mask, server_logits, key)
             self.history.append({"round": r, **self.evaluate()})
         return self.history
 
