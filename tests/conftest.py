@@ -20,3 +20,21 @@ if not os.environ.get("FEDML_TPU_TESTS_ON_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run @pytest.mark.slow tests (DARTS bi-level compiles etc.; "
+             "nightly coverage — the default run stays under the CI budget)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get("FEDML_TPU_RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(reason="slow (compile-heavy); run with --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

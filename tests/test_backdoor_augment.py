@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms.backdoor import (
     apply_trigger,
@@ -62,6 +63,7 @@ def test_cutout_zeroes_patch():
     assert 8 * 8 <= zeros <= 16 * 16  # clipped square at the border
 
 
+@pytest.mark.slow
 def test_augmented_trainer_end_to_end():
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.core.config import FedConfig
