@@ -39,42 +39,63 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
                       arch_lr: float = 3e-4, arch_wd: float = 1e-3,
                       unrolled: bool = False, w_grad_clip: float = 5.0):
     """One DARTS search step: arch update on the val batch, then weight
-    update on the train batch (reference FedNASTrainer.local_search:82)."""
+    update on the train batch (reference FedNASTrainer.local_search:82).
+
+    The weight optimizer is momentum-SGD with the learning rate applied
+    *after* the momentum buffer (torch SGD semantics), taken per-step from
+    the cosine epoch schedule the reference builds inside search()
+    (FedNASTrainer.py:52-53 CosineAnnealingLR over local epochs), so `step`
+    receives `lr_e` explicitly. Train batches carry a validity mask (the
+    packed-client padding convention of algorithms/engine.py).
+    """
+    momentum = cfg.momentum if cfg.momentum else 0.9
     w_opt = optax.chain(
         optax.clip_by_global_norm(w_grad_clip),  # reference clips weights at 5.0
         optax.add_decayed_weights(cfg.wd if cfg.wd else 3e-4),
-        optax.sgd(cfg.lr, momentum=cfg.momentum if cfg.momentum else 0.9),
+        optax.trace(decay=momentum),
+        optax.scale(-1.0),  # step() multiplies by the scheduled lr_e
     )
     a_opt = optax.chain(
         optax.add_decayed_weights(arch_wd),
         optax.adam(arch_lr, b1=0.5, b2=0.999),
     )
 
-    def ce(params, alphas, x, y):
+    def ce(params, alphas, x, y, mask):
         logits = network.apply({"params": params}, x, alphas[0], alphas[1], train=True)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        n = jnp.maximum(mask.sum(), 1.0)
+        loss = (per * mask).sum() / n
+        correct = ((jnp.argmax(logits, -1) == y) * mask).sum()
+        return loss, correct
 
-    def step(state: NASState, train_batch, val_batch):
+    def step(state: NASState, train_batch, val_batch, lr_e):
         params, alphas = state.params, state.alphas
+        tx, ty, tmask = train_batch
+        vx, vy = val_batch
+        vmask = jnp.ones(vy.shape, jnp.float32)
 
         # ---- architecture step (on validation data)
         if unrolled:
             def val_after_one_weight_step(alphas):
-                g = jax.grad(ce)(params, alphas, *train_batch)
-                w2 = jax.tree.map(lambda p, gg: p - cfg.lr * gg, params, g)
-                return ce(w2, alphas, *val_batch)
+                g = jax.grad(lambda p: ce(p, alphas, tx, ty, tmask)[0])(params)
+                w2 = jax.tree.map(lambda p, gg: p - lr_e * gg, params, g)
+                return ce(w2, alphas, vx, vy, vmask)[0]
 
             a_grads = jax.grad(val_after_one_weight_step)(alphas)
         else:
-            a_grads = jax.grad(lambda a: ce(params, a, *val_batch))(alphas)
+            a_grads = jax.grad(lambda a: ce(params, a, vx, vy, vmask)[0])(alphas)
         a_upd, a_opt_state = a_opt.update(a_grads, state.a_opt, alphas)
         alphas = optax.apply_updates(alphas, a_upd)
 
         # ---- weight step (on training data)
-        loss, w_grads = jax.value_and_grad(ce)(params, alphas, *train_batch)
+        (loss, correct), w_grads = jax.value_and_grad(
+            lambda p: ce(p, alphas, tx, ty, tmask), has_aux=True
+        )(params)
         w_upd, w_opt_state = w_opt.update(w_grads, state.w_opt, params)
+        w_upd = jax.tree.map(lambda u: u * lr_e, w_upd)
         params = optax.apply_updates(params, w_upd)
-        return NASState(params, alphas, w_opt_state, a_opt_state), loss
+        n = tmask.sum()
+        return NASState(params, alphas, w_opt_state, a_opt_state), (loss * n, correct, n)
 
     return step, w_opt, a_opt
 
@@ -86,7 +107,7 @@ class FedNASAPI:
 
     def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
                  channels: int = 8, layers: int = 4, arch_lr: float = 3e-4,
-                 unrolled: bool = False):
+                 unrolled: bool = False, lr_min: float = 1e-3):
         self.dataset = dataset
         self.cfg = cfg
         self.network = DARTSNetwork(output_dim=dataset.class_num,
@@ -100,39 +121,85 @@ class FedNASAPI:
         self.global_state = NASState(params, (an, ar), w_opt.init(params),
                                      a_opt.init((an, ar)))
         self._w_opt, self._a_opt = w_opt, a_opt
+        import math as _math
+
+        from fedml_tpu.utils.pytree import tree_where
+
+        # cosine epoch schedule, fresh each round exactly as the reference
+        # builds CosineAnnealingLR inside search() (FedNASTrainer.py:52-53):
+        # epoch e of E runs at eta_min + (lr-eta_min)(1+cos(pi e/E))/2
+        E = cfg.epochs
+        epoch_lrs = jnp.asarray([
+            lr_min + 0.5 * (cfg.lr - lr_min) * (1.0 + _math.cos(_math.pi * e / E))
+            for e in range(E)
+        ], jnp.float32)
 
         def client_search(params, alphas, x, y, count, rng):
-            """cfg.epochs of alternating arch/weight steps; the client's local
-            data is split half train / half val (reference search uses separate
-            train/valid loaders)."""
+            """cfg.epochs full sweeps over the client's local train minibatches
+            (reference local_search iterates the whole train_queue per epoch,
+            FedNASTrainer.py:84-128); each weight step is paired with a random
+            batch from the client's val half (`next(iter(valid_queue))` on a
+            shuffled loader). Local data is split count//2 train / rest val."""
             state = NASState(params, alphas, w_opt.init(params), a_opt.init(alphas))
             n_max = x.shape[0]
-            b = min(cfg.batch_size if cfg.batch_size > 0 else n_max, n_max)
-            half = jnp.maximum(count // 2, 1)
+            n_tr_max = max(n_max // 2, 1)
+            b = min(cfg.batch_size if cfg.batch_size > 0 else n_tr_max, n_tr_max)
+            nb = -(-n_tr_max // b)
+            n_pad = nb * b
+            count_tr = jnp.maximum(count // 2, 1)
+            count_val = jnp.maximum(count - count_tr, 1)
 
-            def epoch(state, erng):
-                # sample a train batch from the first half, val from the second
-                r1, r2 = jax.random.split(erng)
-                ti = jax.random.randint(r1, (b,), 0, half)
-                vi = jax.random.randint(r2, (b,), half, jnp.maximum(count, half + 1))
-                tb = (jnp.take(x, ti, 0), jnp.take(y, ti, 0))
-                vb = (jnp.take(x, vi, 0), jnp.take(y, vi, 0))
-                state, loss = step(state, tb, vb)
-                return state, loss
+            def epoch(state, ein):
+                erng, lr_e = ein
+                shuffle_rng, val_rng = jax.random.split(erng)
+                # permutation of the real train-half samples, padding last
+                # (same shuffle-inside-jit trick as engine.build_local_update)
+                u = jax.random.uniform(shuffle_rng, (n_tr_max,))
+                valid = jnp.arange(n_tr_max) < count_tr
+                perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+                if n_pad > n_tr_max:
+                    perm = jnp.concatenate(
+                        [perm, jnp.zeros(n_pad - n_tr_max, perm.dtype)])
+                xe = jnp.take(x, perm, 0).reshape((nb, b) + x.shape[1:])
+                ye = jnp.take(y, perm, 0).reshape((nb, b) + y.shape[1:])
+                bvalid = ((jnp.arange(n_pad) < count_tr)
+                          .reshape(nb, b).astype(jnp.float32))
+                # one random val batch per train batch, drawn from the val
+                # half [count_tr, count) — all real samples
+                vi = count_tr + jax.random.randint(val_rng, (nb, b), 0, count_val)
+                xv = jnp.take(x, vi.reshape(-1), 0).reshape((nb, b) + x.shape[1:])
+                yv = jnp.take(y, vi.reshape(-1), 0).reshape((nb, b) + y.shape[1:])
 
-            state, losses = jax.lax.scan(epoch, state,
-                                         jax.random.split(rng, cfg.epochs))
-            return state.params, state.alphas, losses.mean()
+                def step_body(st, sin):
+                    bx, by, bm, bxv, byv = sin
+                    new_st, (loss_n, correct, n) = step(
+                        st, (bx, by, bm), (bxv, byv), lr_e)
+                    st = tree_where(n > 0, new_st, st)
+                    return st, (loss_n, correct, n)
+
+                state, ms = jax.lax.scan(step_body, state, (xe, ye, bvalid, xv, yv))
+                return state, tuple(m.sum() for m in ms)
+
+            state, (loss_n, correct, n) = jax.lax.scan(
+                epoch, state, (jax.random.split(rng, E), epoch_lrs))
+            return (state.params, state.alphas,
+                    loss_n.sum(), correct.sum(), n.sum())
 
         def round_fn(gstate: NASState, x, y, counts, rng):
             crngs = jax.random.split(rng, x.shape[0])
-            params, alphas, losses = jax.vmap(
+            params, alphas, loss_n, correct, n = jax.vmap(
                 client_search, in_axes=(None, None, 0, 0, 0, 0)
             )(gstate.params, gstate.alphas, x, y, counts, crngs)
             w = counts.astype(jnp.float32)
             new_params = tree_weighted_mean(params, w)
             new_alphas = tree_weighted_mean(alphas, w)
-            return NASState(new_params, new_alphas, gstate.w_opt, gstate.a_opt), losses.mean()
+            n_tot = jnp.maximum(n.sum(), 1.0)
+            metrics = {"search_loss": loss_n.sum() / n_tot,
+                       "search_acc": correct.sum() / n_tot,
+                       # total (sample, epoch) visits — proves every real
+                       # train-half sample is swept once per epoch
+                       "search_samples": n.sum()}
+            return NASState(new_params, new_alphas, gstate.w_opt, gstate.a_opt), metrics
 
         self.round_fn = jax.jit(round_fn)
         self.genotype_history: list = []
@@ -144,28 +211,53 @@ class FedNASAPI:
         idx = client_sampling(round_idx, self.dataset.client_num, self.cfg.client_num_per_round)
         x, y, counts = self.dataset.train.select(idx)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
-        self.global_state, loss = self.round_fn(
+        self.global_state, metrics = self.round_fn(
             self.global_state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), rng
         )
         geno = parse_genotype(*self.global_state.alphas)
         self.genotype_history.append(geno)
-        return {"search_loss": float(loss), "genotype": geno}
+        return {"search_loss": float(metrics["search_loss"]),
+                "search_acc": float(metrics["search_acc"]),
+                "search_samples": int(metrics["search_samples"]),
+                "genotype": geno}
 
     def train(self):
         for r in range(self.cfg.comm_round):
             rec = self.train_one_round(r)
-            self.history.append({"round": r, "search_loss": rec["search_loss"]})
+            self.history.append({"round": r, "search_loss": rec["search_loss"],
+                                 "search_acc": rec["search_acc"]})
         return self.history
 
-    def evaluate(self) -> dict[str, float]:
+    def evaluate(self, batch_size: int = 256) -> dict[str, float]:
+        """Full-test-set accuracy, batched (reference FedNASAggregator.infer
+        sweeps the entire test loader, FedNASAggregator.py:137-171)."""
+        import math as _math
+
+        import numpy as np
+
         xte, yte = self.dataset.test_global
-        x = jnp.asarray(xte[:256])
-        y = jnp.asarray(yte[:256])
+        n = xte.shape[0]
+        b = min(batch_size, n)
+        nb = _math.ceil(n / b)
+        n_pad = nb * b
+        xp = np.zeros((n_pad,) + xte.shape[1:], np.float32)
+        yp = np.zeros((n_pad,), np.int32)
+        xp[:n], yp[:n] = xte, yte
+        mask = (np.arange(n_pad) < n).astype(np.float32)
+        xb = xp.reshape((nb, b) + xte.shape[1:])
+        yb = yp.reshape(nb, b)
+        mb = mask.reshape(nb, b)
         an, ar = self.global_state.alphas
 
         @jax.jit
-        def acc(params):
-            logits = self.network.apply({"params": params}, x, an, ar, train=False)
-            return (jnp.argmax(logits, -1) == y).mean()
+        def acc(params, xb, yb, mb):
+            def body(_, batch):
+                bx, by, bm = batch
+                logits = self.network.apply({"params": params}, bx, an, ar, train=False)
+                return None, ((jnp.argmax(logits, -1) == by) * bm).sum()
+            _, correct = jax.lax.scan(body, None, (xb, yb, mb))
+            return correct.sum() / n
 
-        return {"Test/Acc": float(acc(self.global_state.params))}
+        return {"Test/Acc": float(acc(self.global_state.params,
+                                      jnp.asarray(xb), jnp.asarray(yb),
+                                      jnp.asarray(mb)))}

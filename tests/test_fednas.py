@@ -53,6 +53,7 @@ def test_parse_genotype_structure():
     assert all(op == "sep_conv_3x3" for op, _ in g2.normal)
 
 
+@pytest.mark.slow
 def test_unrolled_arch_gradient_differs_from_first_order():
     """The exact unrolled arch gradient (differentiating through the inner
     weight step) carries a second-order term the first-order approximation
@@ -89,6 +90,7 @@ def test_unrolled_arch_gradient_differs_from_first_order():
     assert diff > 1e-8
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("unrolled", [False])
 def test_fednas_search_round(unrolled):
     from fedml_tpu.algorithms.fednas import FedNASAPI
@@ -105,15 +107,48 @@ def test_fednas_search_round(unrolled):
                           train_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
                           test_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
                           class_num=4)
-    cfg = FedConfig(comm_round=2, epochs=2, batch_size=8, lr=0.05,
+    cfg = FedConfig(comm_round=2, epochs=2, batch_size=4, lr=0.05,
                     client_num_in_total=C, client_num_per_round=C)
     api = FedNASAPI(ds, cfg, channels=4, layers=2, unrolled=unrolled)
     a0 = jax.tree.map(lambda a: np.asarray(a).copy(), api.global_state.alphas)
-    hist = api.train()
-    assert np.isfinite(hist[-1]["search_loss"])
+    rec = api.train_one_round(0)
+    assert np.isfinite(rec["search_loss"])
+    # faithful local search: every real train-half sample is visited exactly
+    # once per local epoch (reference local_search sweeps the whole
+    # train_queue, FedNASTrainer.py:84-128), including a ragged client
+    counts = np.full(C, n)
+    assert rec["search_samples"] == cfg.epochs * sum(c // 2 for c in counts)
+    api.train_one_round(1)
     # alphas moved (architecture search is actually happening)
     a1 = api.global_state.alphas
     assert float(jnp.max(jnp.abs(a1[0] - a0[0]))) > 1e-6
     assert len(api.genotype_history) == 2
     acc = api.evaluate()["Test/Acc"]
     assert 0.0 <= acc <= 1.0
+
+
+@pytest.mark.slow
+def test_fednas_sweep_counts_ragged_clients():
+    """Full-sweep accounting with unequal client sizes: search_samples must be
+    sum over clients of epochs * (count_i // 2), proving padded batches are
+    masked out and every real sample is swept."""
+    from fedml_tpu.algorithms.fednas import FedNASAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.packing import PackedClients
+    from fedml_tpu.data.registry import FederatedDataset
+
+    rng = np.random.RandomState(0)
+    C, n_max = 2, 20
+    counts = np.array([20, 9], np.int32)
+    x = rng.rand(C, n_max, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 4, size=(C, n_max)).astype(np.int32)
+    packed = PackedClients(x, y, counts)
+    ds = FederatedDataset(name="tiny", train=packed, test=packed,
+                          train_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          test_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          class_num=4)
+    cfg = FedConfig(comm_round=1, epochs=3, batch_size=4, lr=0.05,
+                    client_num_in_total=C, client_num_per_round=C)
+    api = FedNASAPI(ds, cfg, channels=4, layers=2)
+    rec = api.train_one_round(0)
+    assert rec["search_samples"] == cfg.epochs * sum(int(c) // 2 for c in counts)
