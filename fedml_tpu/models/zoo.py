@@ -85,3 +85,39 @@ def _vgg11(output_dim, **kw):
 @register_model("vgg16")
 def _vgg16(output_dim, **kw):
     return VGG(variant="vgg16", output_dim=output_dim)
+
+
+@register_model("deeplab")
+def _deeplab(output_dim, **kw):
+    # FedSeg encoder-decoder (reference fedseg ships the algorithm without a
+    # bundled model; DeepLabV3+ is the upstream family it targets)
+    from fedml_tpu.models.segmentation import DeepLabV3Plus
+
+    return DeepLabV3Plus(output_dim=output_dim, width=kw.get("width", 32))
+
+
+@register_model("fcn")
+def _fcn(output_dim, **kw):
+    from fedml_tpu.models.segmentation import SimpleFCN
+
+    return SimpleFCN(output_dim=output_dim, width=kw.get("width", 16))
+
+
+@register_model("mobilenet_v3")
+def _mobilenet_v3(output_dim, **kw):
+    # reference main_fedavg.py "mobilenet_v3" -> MobileNetV3(model_mode=...)
+    from fedml_tpu.models.mobilenet_v3 import MobileNetV3
+
+    return MobileNetV3(output_dim=output_dim,
+                       mode=kw.get("mode", "LARGE"),
+                       multiplier=kw.get("multiplier", 1.0),
+                       dropout_rate=kw.get("dropout_rate", 0.0))
+
+
+@register_model("efficientnet")
+def _efficientnet(output_dim, **kw):
+    # reference main_fedavg.py "efficientnet" -> EfficientNet.from_name
+    from fedml_tpu.models.efficientnet import EfficientNet
+
+    return EfficientNet.from_name(kw.get("variant", "efficientnet-b0"),
+                                  output_dim=output_dim)

@@ -76,5 +76,47 @@ def test_rnn_stackoverflow_shapes():
 def test_registry_lists_models():
     names = available_models()
     for required in ("lr", "cnn", "resnet56", "resnet18_gn", "mobilenet", "rnn",
-                     "rnn_stackoverflow", "vgg11", "mlp", "har_cnn"):
+                     "rnn_stackoverflow", "vgg11", "mlp", "har_cnn",
+                     "mobilenet_v3", "efficientnet"):
         assert required in names
+
+
+def _param_count_abstract(module, x_shape):
+    """tree_size via jax.eval_shape — verifies exact parameter structure
+    without compiling the (large) forward graph."""
+    rng = jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(
+        lambda: module.init({"params": rng, "dropout": rng},
+                            jnp.zeros(x_shape), train=False))
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes["params"]))
+
+
+@pytest.mark.parametrize("mode,expected", [
+    ("LARGE", 3_884_328),   # reference MobileNetV3(model_mode="LARGE", 10 cls)
+    ("SMALL", 1_843_272),   # reference MobileNetV3(model_mode="SMALL", 10 cls)
+])
+def test_mobilenet_v3_param_parity(mode, expected):
+    m = create_model("mobilenet_v3", output_dim=10, mode=mode)
+    assert _param_count_abstract(m, (2, 32, 32, 3)) == expected
+
+
+@pytest.mark.parametrize("variant,expected", [
+    ("efficientnet-b0", 4_020_358),  # reference from_name(..., num_classes=10)
+    ("efficientnet-b1", 6_525_994),  # b1 exercises round_repeats (depth 1.1)
+    ("efficientnet-b3", 10_711_602),
+])
+def test_efficientnet_param_parity(variant, expected):
+    m = create_model("efficientnet", output_dim=10, variant=variant)
+    assert _param_count_abstract(m, (1, 32, 32, 3)) == expected
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,kw", [
+    ("mobilenet_v3", {"mode": "SMALL"}),
+    ("efficientnet", {"variant": "efficientnet-b0"}),
+])
+def test_new_cv_models_forward(name, kw):
+    m = create_model(name, output_dim=10, **kw)
+    v, out = _init_and_apply(m, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(out)))
