@@ -42,15 +42,46 @@ def poison_client_data(x: np.ndarray, y: np.ndarray, count: int,
     return x, y
 
 
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.247, 0.243, 0.262], np.float32)
+
+
+def load_edge_case_sets(data_dir: str = "./data", normalize=True):
+    """Real edge-case backdoor sets when present (reference
+    edge_case_examples/data_loader.py:329-385 southwest pickles). Returns
+    (x_poison_train, x_poison_test, target_label) or None; callers fall back
+    to the pixel-trigger substitute.
+
+    `normalize=True` applies the CIFAR-10 channel stats so the images match
+    what a model trained through sources.load_cifar_arrays sees (the
+    reference applies its CIFAR normalize transform to these sets too);
+    pass False for raw [0,1] pixels or a (mean, std) pair for other stats."""
+    from fedml_tpu.data import readers
+
+    out = readers.read_southwest(data_dir)
+    if out is None or normalize is False:
+        return out
+    mean, std = (CIFAR10_MEAN, CIFAR10_STD) if normalize is True else normalize
+    xtr, xte, target = out
+    return (xtr - mean) / std, (xte - mean) / std, target
+
+
 def backdoor_metrics(predict_fn, x_clean: np.ndarray, y_clean: np.ndarray,
-                     target_label: int, trigger_size: int = 3) -> dict[str, float]:
+                     target_label: int, trigger_size: int = 3,
+                     x_edge_case: np.ndarray | None = None) -> dict[str, float]:
     """Main-task accuracy + backdoor success rate (reference
-    test_on_server_for_all_clients + poisoned-task eval). The backdoor rate
-    is measured on non-target-class samples only, as the reference does."""
+    test_on_server_for_all_clients + poisoned-task eval). With
+    `x_edge_case` (e.g. the southwest test pickle via load_edge_case_sets)
+    the success rate is measured on those images exactly as the reference's
+    targetted-task eval does (FedAvgRobustAggregator.py:14-112); otherwise
+    the pixel-trigger substitute is stamped on non-target-class samples."""
     logits = predict_fn(jnp.asarray(x_clean))
     main_acc = float((jnp.argmax(logits, -1) == jnp.asarray(y_clean)).mean())
-    keep = y_clean != target_label
-    x_trig = apply_trigger(x_clean[keep], trigger_size)
+    if x_edge_case is not None:
+        x_trig = np.asarray(x_edge_case, np.float32)
+    else:
+        keep = y_clean != target_label
+        x_trig = apply_trigger(x_clean[keep], trigger_size)
     logits_t = predict_fn(jnp.asarray(x_trig))
     backdoor_rate = float((jnp.argmax(logits_t, -1) == target_label).mean())
     return {"MainTask/Acc": main_acc, "Backdoor/SuccessRate": backdoor_rate}

@@ -21,13 +21,34 @@ from fedml_tpu.data.packing import pack_client_data, pack_client_lists
 from fedml_tpu.data.registry import FederatedDataset, register_loader
 
 
-def _partition(method: str, y: np.ndarray, client_num: int, alpha: float, class_num: int, rng):
+def _partition(method: str, y: np.ndarray, client_num: int, alpha: float, class_num: int, rng,
+               data_dir: str = "./data", dataset: str = "", partition_file: str | None = None):
     if method == "homo":
         return homo_partition(len(y), client_num, rng)
     if method == "hetero":
         return non_iid_partition_with_dirichlet_distribution(y, client_num, class_num, alpha, rng=rng)
     if method == "p-hetero":
         return p_hetero_partition(client_num, y, alpha, rng)
+    if method == "hetero-fix":
+        # pre-recorded partition map (reference cifar10/data_loader.py:33-46 +
+        # :163-170 reads net_dataidx_map.txt written by a prior hetero run)
+        from fedml_tpu.data import readers
+
+        path = partition_file or readers.find_hetero_fix_map(data_dir, dataset)
+        if path is None:
+            sources.log.warning(
+                "hetero-fix map not found under %s for %s — falling back to "
+                "a fresh LDA (hetero) partition", data_dir, dataset)
+            return non_iid_partition_with_dirichlet_distribution(
+                y, client_num, class_num, alpha, rng=rng)
+        m = readers.read_net_dataidx_map(path)
+        if len(m) != client_num:
+            raise ValueError(
+                f"hetero-fix map at {path} records {len(m)} clients but "
+                f"--client_num_in_total is {client_num}; pass the matching "
+                "client count (the map is a fixed pre-recorded partition)")
+        # remap possibly non-contiguous recorded ids to 0..C-1 (sorted order)
+        return {i: np.asarray(m[k], np.int64) for i, k in enumerate(sorted(m))}
     raise ValueError(f"unknown partition method {method!r}")
 
 
@@ -42,10 +63,14 @@ def _from_global(
     partition_method,
     partition_alpha,
     seed,
+    data_dir="./data",
+    partition_file=None,
 ):
     rng = np.random.RandomState(seed)
-    tr_map = _partition(partition_method, ytr, client_num, partition_alpha, class_num, rng)
-    te_map = _partition(partition_method if partition_method != "hetero" else "homo", yte, client_num, partition_alpha, class_num, rng)
+    tr_map = _partition(partition_method, ytr, client_num, partition_alpha, class_num, rng,
+                        data_dir=data_dir, dataset=name, partition_file=partition_file)
+    te_map = _partition(partition_method if partition_method in ("homo", "p-hetero") else "homo",
+                        yte, client_num, partition_alpha, class_num, rng)
     record_net_data_stats(ytr, tr_map, name)
     return FederatedDataset(
         name=name,
@@ -138,10 +163,11 @@ def _register_global_image(name, class_num, source_name=None):
 
     @register_loader(name)
     def _load(data_dir="./data", client_num_in_total=10, partition_method="hetero",
-              partition_alpha=0.5, seed=0, **_):
+              partition_alpha=0.5, seed=0, partition_file=None, **_):
         xtr, ytr, xte, yte = sources.load_cifar_arrays(source_name or name, data_dir, seed)
         return _from_global(name, xtr, ytr, xte, yte, class_num,
-                            client_num_in_total, partition_method, partition_alpha, seed)
+                            client_num_in_total, partition_method, partition_alpha, seed,
+                            data_dir=data_dir, partition_file=partition_file)
 
     return _load
 
@@ -152,26 +178,189 @@ _register_global_image("cifar100", 100)
 
 @register_loader("cinic10")
 def load_cinic10(data_dir="./data", client_num_in_total=10, partition_method="hetero",
-                 partition_alpha=0.5, seed=0, **_):
-    """CINIC-10 (CIFAR-shaped ImageNet+CIFAR mix, reference cinic10/).
-    Reads `cinic10.npz` (x_train/y_train/x_test/y_test) if present; never
-    substitutes CIFAR-10 files — absent real data means the surrogate."""
-    p = os.path.join(data_dir, "cinic10.npz")
-    if os.path.exists(p):
-        try:
-            d = np.load(p)
-            xtr, ytr = d["x_train"].astype(np.float32), d["y_train"].astype(np.int32)
-            xte, yte = d["x_test"].astype(np.float32), d["y_test"].astype(np.int32)
-        except Exception as e:
-            sources.log.warning("failed reading %s (%s) — using surrogate", p, e)
+                 partition_alpha=0.5, seed=0, partition_file=None, **_):
+    """CINIC-10 (CIFAR-shaped ImageNet+CIFAR mix). Reads the reference's
+    folder tree <root>/{train,test}/<class>/*.png first (reference
+    cinic10/data_loader.py:222-239 ImageFolder), then `cinic10.npz`, then a
+    seeded surrogate; never substitutes CIFAR-10 files."""
+    from fedml_tpu.data import readers
+
+    ref = None
+    try:
+        ref = readers.read_cinic10(data_dir)
+    except Exception as e:
+        sources.log.warning("failed reading cinic10 folder tree (%s)", e)
+    if ref is not None:
+        xtr, ytr, xte, yte = ref
+    else:
+        p = os.path.join(data_dir, "cinic10.npz")
+        if os.path.exists(p):
+            try:
+                d = np.load(p)
+                xtr, ytr = d["x_train"].astype(np.float32), d["y_train"].astype(np.int32)
+                xte, yte = d["x_test"].astype(np.float32), d["y_test"].astype(np.int32)
+            except Exception as e:
+                sources.log.warning("failed reading %s (%s) — using surrogate", p, e)
+                ref = False
+        else:
+            sources.log.warning("cinic10 folder tree / npz not found under %s — "
+                                "using seeded surrogate", data_dir)
+            ref = False
+        if ref is False:
             xtr, ytr = sources.synthetic_image_classes(5000, 10, (32, 32, 3), seed, proto_seed=seed + 778)
             xte, yte = sources.synthetic_image_classes(1000, 10, (32, 32, 3), seed + 1, proto_seed=seed + 778)
-    else:
-        sources.log.warning("cinic10.npz not found under %s — using seeded surrogate", data_dir)
-        xtr, ytr = sources.synthetic_image_classes(5000, 10, (32, 32, 3), seed, proto_seed=seed + 778)
-        xte, yte = sources.synthetic_image_classes(1000, 10, (32, 32, 3), seed + 1, proto_seed=seed + 778)
     return _from_global("cinic10", xtr, ytr, xte, yte, 10,
-                        client_num_in_total, partition_method, partition_alpha, seed)
+                        client_num_in_total, partition_method, partition_alpha, seed,
+                        data_dir=data_dir, partition_file=partition_file)
+
+
+@register_loader("emnist")
+def load_emnist(data_dir="./data", client_num_in_total=10, partition_method="homo",
+                partition_alpha=0.5, seed=0, partition_file=None, **_):
+    """EMNIST balanced, 47 classes (reference MNIST/data_loader.py:55-60 —
+    the mnist/fmnist/emnist trio shares homo / p-hetero partitioning)."""
+    xtr, ytr, xte, yte = sources.load_emnist_arrays(data_dir, seed=seed)
+    return _from_global("emnist", xtr, ytr, xte, yte, 47,
+                        client_num_in_total, partition_method, partition_alpha, seed,
+                        data_dir=data_dir, partition_file=partition_file)
+
+
+@register_loader("ILSVRC2012")
+def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
+                  image_size=224, cap_per_class=None, **_):
+    """ImageNet partitioned by class blocks: with 100 clients each owns 10
+    consecutive classes, with 1000 each owns one (reference
+    ImageNet/data_loader.py:190-240 / datasets.py:81-129 net_dataidx_map).
+    Reads the ILSVRC2012 folder tree; surrogate when absent."""
+    from fedml_tpu.data import readers
+
+    ref = None
+    try:
+        ref = readers.read_imagenet_folder(data_dir, image_size, cap_per_class)
+    except Exception as e:
+        sources.log.warning("failed reading ImageNet tree (%s)", e)
+    if ref is not None:
+        xtr, ytr, xte, yte, classes = ref
+        class_num = len(classes)
+    else:
+        sources.log.warning("ImageNet folder tree not found under %s — using "
+                            "tiny seeded surrogate", data_dir)
+        class_num = max(10, client_num_in_total)
+        sz = min(image_size, 32)
+        xtr, ytr = sources.synthetic_image_classes(
+            class_num * 12, class_num, (sz, sz, 3), seed, proto_seed=seed + 1012)
+        xte, yte = sources.synthetic_image_classes(
+            class_num * 3, class_num, (sz, sz, 3), seed + 1, proto_seed=seed + 1012)
+    # class-blocked natural partition: classes are split across clients with
+    # array_split so EVERY class lands on exactly one client even when
+    # class_num % client_num != 0 (reference per-class net_dataidx_map)
+    class_blocks = np.array_split(np.arange(class_num), client_num_in_total)
+    order = np.argsort(ytr, kind="stable")
+    xtr_l, ytr_l = [], []
+    for block in class_blocks:
+        if len(block):
+            sel = order[(ytr[order] >= block[0]) & (ytr[order] <= block[-1])]
+        else:
+            sel = np.array([], np.int64)
+        xtr_l.append(xtr[sel])
+        ytr_l.append(ytr[sel])
+    train = pack_client_lists(xtr_l, ytr_l)
+    te_map = homo_partition(len(yte), client_num_in_total, np.random.RandomState(seed))
+    return FederatedDataset(
+        name="ILSVRC2012", train=train, test=pack_client_data(xte, yte, te_map),
+        train_global=(xtr, ytr), test_global=(xte, yte), class_num=class_num,
+    )
+
+
+def _register_landmarks(variant, default_clients):
+    @register_loader(variant)
+    def _load(data_dir="./data", client_num_in_total=None, seed=0, image_size=64, **_):
+        """Google Landmarks user-split (reference Landmarks/data_loader.py:202
+        load_partition_data_landmarks; gld23k = 233 users / 203 classes,
+        gld160k = 1262 users / 2028 classes)."""
+        from fedml_tpu.data import readers
+
+        client_num = client_num_in_total or default_clients
+        ref = None
+        try:
+            ref = readers.read_landmarks(data_dir, variant, image_size)
+        except Exception as e:
+            sources.log.warning("failed reading %s (%s)", variant, e)
+        if ref is not None:
+            xtr_l, ytr_l, xte, yte, class_num = ref
+        else:
+            sources.log.warning("%s csv/images not found under %s — using tiny "
+                                "seeded surrogate", variant, data_dir)
+            class_num = 203 if variant == "gld23k" else 2028
+            rng = np.random.RandomState(seed)
+            protos = rng.normal(0, 1, (class_num, image_size, image_size, 3)).astype(np.float32)
+            xtr_l, ytr_l = [], []
+            for _c in range(client_num):
+                n_i = int(np.clip(rng.lognormal(3.0, 0.6), 4, 128))
+                y_i = rng.randint(0, class_num, n_i).astype(np.int32)
+                xtr_l.append(protos[y_i] * 0.6 +
+                             rng.normal(0, 0.35, (n_i, image_size, image_size, 3)).astype(np.float32))
+                ytr_l.append(y_i)
+            yte = rng.randint(0, class_num, 64).astype(np.int32)
+            xte = protos[yte] * 0.6 + rng.normal(0, 0.35, (64, image_size, image_size, 3)).astype(np.float32)
+        train = pack_client_lists(xtr_l, ytr_l)
+        te_map = homo_partition(len(yte), len(xtr_l), np.random.RandomState(seed))
+        return FederatedDataset(
+            name=variant, train=train, test=pack_client_data(xte, yte, te_map),
+            train_global=(np.concatenate([a[:c] for a, c in zip(train.x, train.counts)]),
+                          np.concatenate([a[:c] for a, c in zip(train.y, train.counts)])),
+            test_global=(xte, yte), class_num=int(class_num),
+        )
+
+    return _load
+
+
+_register_landmarks("gld23k", 233)
+_register_landmarks("gld160k", 1262)
+
+
+@register_loader("pascal_voc")
+def load_pascal_voc(data_dir="./data", client_num_in_total=4, partition_method="homo",
+                    partition_alpha=0.5, seed=0, image_size=64, **_):
+    """Pascal VOC semantic segmentation for the FedSeg path (21 classes,
+    255 = ignore border). Reads the VOCdevkit tree when present, else a
+    seeded surrogate of blob-shaped masks so losses/mIoU are meaningful."""
+    from fedml_tpu.data import readers
+
+    ref = None
+    try:
+        ref = readers.read_pascal_voc(data_dir, image_size)
+    except Exception as e:
+        sources.log.warning("failed reading VOC tree (%s)", e)
+    if ref is not None:
+        xtr, ytr, xte, yte = ref
+    else:
+        sources.log.warning("VOCdevkit not found under %s — using seeded "
+                            "segmentation surrogate", data_dir)
+        rng = np.random.RandomState(seed)
+
+        def synth(n):
+            h = image_size
+            x = rng.rand(n, h, h, 3).astype(np.float32) * 0.2
+            y = np.zeros((n, h, h), np.int32)
+            for i in range(n):
+                # 1-3 class blobs on background 0; thin 255 border ring
+                for _b in range(rng.randint(1, 4)):
+                    c = rng.randint(1, 21)
+                    cy, cx, r = rng.randint(4, h - 4), rng.randint(4, h - 4), rng.randint(3, max(4, h // 4))
+                    yy, xx = np.ogrid[:h, :h]
+                    blob = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+                    ring = ((yy - cy) ** 2 + (xx - cx) ** 2 <= (r + 1) ** 2) & ~blob
+                    y[i][blob] = c
+                    y[i][ring] = 255
+                    x[i][blob] += np.array([c / 21.0, (c % 5) / 5.0, (c % 3) / 3.0], np.float32)
+            return x, y
+
+        xtr, ytr = synth(40)
+        xte, yte = synth(10)
+    return _from_global("pascal_voc", xtr, ytr, xte, yte, 21,
+                        client_num_in_total, partition_method, partition_alpha, seed,
+                        data_dir=data_dir)
 
 
 @register_loader("fmnist")

@@ -88,6 +88,22 @@ def load_mnist_arrays(data_dir: str = "./data", flatten: bool = False, seed: int
     return xtr, ytr, xte, yte
 
 
+def load_emnist_arrays(data_dir: str = "./data", seed: int = 0, split: str = "balanced"):
+    """EMNIST balanced (47 classes, reference MNIST/data_loader.py:55-60 via
+    torchvision EMNIST split='balanced'), normalized like MNIST. Reads the
+    NIST gzip-IDX files when present, else a seeded surrogate."""
+    from fedml_tpu.data import readers
+
+    ref = readers.read_emnist(data_dir, split)
+    if ref is not None:
+        xtr, ytr, xte, yte = ref
+        return ((xtr - 0.1307) / 0.3081, ytr, (xte - 0.1307) / 0.3081, yte)
+    log.warning("EMNIST IDX files not found under %s — using seeded surrogate", data_dir)
+    xtr, ytr = synthetic_image_classes(4700, 47, (28, 28, 1), seed, proto_seed=seed + 4747)
+    xte, yte = synthetic_image_classes(940, 47, (28, 28, 1), seed + 1, proto_seed=seed + 4747)
+    return xtr, ytr, xte, yte
+
+
 def load_femnist_arrays(data_dir: str = "./data", client_num: int = 3400, seed: int = 0):
     """FederatedEMNIST: per-writer natural split, 62 classes, 28x28
     (reference FederatedEMNIST/data_loader.py:16-77, TFF h5 export).
@@ -427,6 +443,22 @@ def load_tabular_arrays(name: str, data_dir: str = "./data", seed: int = 0):
         "chmnist": ((64, 64, 1), 8),   # colorectal-histology MNIST
     }
     shape, class_num = dims[name]
+    # reference on-disk formats first (HAR Inertial Signals txt, UCIAdult
+    # income_proc npy, purchase/texas not_normalized pickles — see
+    # fedml_tpu/data/readers.py), then the npz convenience format
+    from fedml_tpu.data import readers
+
+    ref = None
+    if name == "har":
+        ref = readers.read_har(data_dir)
+    elif name == "adult":
+        ref = readers.read_adult(data_dir)
+    elif name in ("purchase100", "texas100"):
+        ref = readers.read_purchase_texas(name, data_dir)
+    if ref is not None:
+        xtr, ytr, xte, yte = ref
+        return (xtr.astype(np.float32), ytr.astype(np.int32),
+                xte.astype(np.float32), yte.astype(np.int32))
     p = os.path.join(data_dir, f"{name}.npz")
     if os.path.exists(p):
         try:
