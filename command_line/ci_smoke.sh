@@ -1,0 +1,119 @@
+#!/usr/bin/env bash
+# CI smoke matrix: every algorithm runnable from its CLI on tiny configs, with
+# wandb-summary.json asserts — the reference's CI strategy (SURVEY §4,
+# command_line/CI-script-fedavg.sh:32-62) rebuilt for the TPU framework.
+#
+# Runs on the virtual CPU mesh (same trick as tests/conftest.py) so it needs
+# no TPU. Usage: bash command_line/ci_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+RUN_DIR="${RUN_DIR:-./wandb/ci-smoke/files}"
+rm -rf "$RUN_DIR"
+
+assert_summary () {  # assert_summary <key> <min> <max>
+  python - "$RUN_DIR" "$1" "$2" "$3" <<'EOF'
+import json, sys
+run_dir, key, lo, hi = sys.argv[1], sys.argv[2], float(sys.argv[3]), float(sys.argv[4])
+with open(f"{run_dir}/wandb-summary.json") as f:
+    s = json.load(f)
+v = s[key]
+assert lo <= v <= hi, f"{key}={v} not in [{lo}, {hi}]"
+print(f"OK {key}={v}")
+EOF
+}
+
+COMMON="--run_dir $RUN_DIR --data_dir ./data --seed 0"
+
+echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
+python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
+
+echo "== fedavg standalone smoke (2 clients, 1 round, batch 4)"
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
+  --epochs 1 --batch_size 4
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== fedavg equivalence oracle: full-batch E=1 FedAvg == centralized"
+python - <<'EOF'
+# the reference CI's key trick (CI-script-fedavg.sh:44-50) as a direct check
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from fedml_tpu.algorithms.centralized import CentralizedTrainer
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+
+ds = load_dataset("mnist", client_num_in_total=10, partition_method="homo")
+cfg = FedConfig(comm_round=3, epochs=1, batch_size=-1, lr=0.03,
+                client_num_in_total=10, client_num_per_round=10)
+fed = FedAvgAPI(ds, cfg, ClassificationTrainer(create_model("lr", output_dim=10)))
+fed.train()
+cen = CentralizedTrainer(ds, cfg, ClassificationTrainer(create_model("lr", output_dim=10)))
+cen.train()
+fa = fed.test_global(0)["Test/Acc"]; ca = cen.evaluate()["Test/Acc"]
+assert abs(fa - ca) < 1e-3, (fa, ca)
+print(f"OK equivalence: fedavg={fa:.4f} centralized={ca:.4f}")
+EOF
+
+echo "== fedopt"
+python -m fedml_tpu.experiments.main_fedopt $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== fednova"
+python -m fedml_tpu.experiments.main_fednova $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== fedavg_robust"
+python -m fedml_tpu.experiments.main_fedavg_robust $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== hierarchical"
+python -m fedml_tpu.experiments.main_hierarchical $COMMON --dataset mnist --model lr \
+  --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 --epochs 1 \
+  --batch_size 4 --group_num 2
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== decentralized (online regret)"
+python -m fedml_tpu.experiments.main_decentralized --run_dir "$RUN_DIR" \
+  --client_number 4 --iterations 20 --neighbor_num 2
+
+echo "== fedgkt"
+python -m fedml_tpu.experiments.main_fedgkt $COMMON --dataset cifar10 \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 1 \
+  --epochs 1 --epochs_server 1 --batch_size 64 --partition_method homo \
+  --server_blocks 1 1 1
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== split_nn"
+python -m fedml_tpu.experiments.main_split_nn $COMMON --dataset cifar10 \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
+  --epochs 1 --batch_size 8 --partition_method homo
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== classical_vertical_fl"
+python -m fedml_tpu.experiments.main_vfl --run_dir "$RUN_DIR" --dataset adult \
+  --party_num 3 --epochs 2 --batch_size 32
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== turboaggregate (secure group-ring aggregation)"
+python -m fedml_tpu.experiments.main_turboaggregate $COMMON --dataset mnist --model lr \
+  --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 \
+  --epochs 1 --batch_size 4 --num_groups 2 --partition_method homo
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== fedseg"
+python -m fedml_tpu.experiments.main_fedseg $COMMON --comm_round 1 --epochs 1 \
+  --batch_size 4 --image_size 24 --model fcn
+assert_summary "Test/mIoU" 0.0 1.0
+
+echo "ALL SMOKE TESTS PASSED"

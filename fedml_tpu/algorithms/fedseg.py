@@ -109,7 +109,7 @@ def confusion_matrix(pred, target, num_classes: int, ignore_index: int = 255):
 def evaluator_scores(cm):
     """Pixel acc / class acc / mIoU / FWIoU from a confusion matrix
     (reference Evaluator.Pixel_Accuracy etc.)."""
-    cm = cm.astype(jnp.float64)
+    cm = cm.astype(jnp.float32)
     total = jnp.maximum(cm.sum(), 1.0)
     tp = jnp.diagonal(cm)
     pixel_acc = tp.sum() / total
@@ -127,6 +127,98 @@ def evaluator_scores(cm):
         "mIoU": float(miou),
         "FWIoU": float(fwiou),
     }
+
+
+# ---------------------------------------------------------------- FedSegAPI
+
+
+class FedSegAPI:
+    """Federated segmentation API (reference FedSegAPI.py +
+    FedSegAggregator.py:65-199): FedAvg rounds over an encoder-decoder model
+    via the shared engine, with the segmentation evaluator (pixel acc, class
+    acc, mIoU, FWIoU) reported per eval round.
+
+    Composition over inheritance-of-managers: the round loop IS FedAvgAPI
+    (one jitted round fn); only the eval surface differs."""
+
+    def __init__(self, dataset, config, model_trainer=None,
+                 loss_type: str = "ce", aggregator_name: str = "fedavg"):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+        if model_trainer is None:
+            from fedml_tpu.models.registry import create_model
+
+            module = create_model("deeplab", output_dim=dataset.class_num)
+            model_trainer = SegmentationTrainer(module, loss_type=loss_type)
+        self.trainer = model_trainer
+        self._inner = FedAvgAPI(dataset, config, model_trainer,
+                                aggregator_name=aggregator_name)
+        self.dataset = dataset
+        self.cfg = config
+        self.history = self._inner.history
+        num_classes = dataset.class_num
+
+        def cm_batches(variables, bx, by, bm):
+            """One sweep over the packed test batches accumulating BOTH the
+            confusion matrix and the masked CE loss (a second full forward
+            pass just for the loss would double eval cost on the most
+            expensive model family in the repo)."""
+
+            def body(carry, batch):
+                cm, loss_sum, n_sum = carry
+                x, y, m = batch
+                logits, _ = model_trainer.apply(variables, x, None, train=False)
+                per, pix_mask = model_trainer._loss(logits, y)
+                samp = m.astype(per.dtype).reshape((-1,) + (1,) * (per.ndim - 1))
+                mm = pix_mask * samp
+                pred = jnp.argmax(logits, -1)
+                # padded samples -> ignore_index so they never count
+                y = jnp.where(m.reshape((-1,) + (1,) * (y.ndim - 1)) > 0, y,
+                              model_trainer.ignore_index)
+                cm = cm + confusion_matrix(pred, y, num_classes,
+                                           model_trainer.ignore_index)
+                return (cm, loss_sum + (per * mm).sum(), n_sum + mm.sum()), None
+
+            cm0 = jnp.zeros((num_classes, num_classes), jnp.int32)
+            (cm, loss_sum, n_sum), _ = jax.lax.scan(
+                body, (cm0, jnp.float32(0), jnp.float32(0)), (bx, by, bm))
+            return cm, loss_sum / jnp.maximum(n_sum, 1.0)
+
+        self._cm_fn = jax.jit(cm_batches)
+
+    @property
+    def global_variables(self):
+        return self._inner.global_variables
+
+    def train_one_round(self, round_idx: int):
+        return self._inner.train_one_round(round_idx)
+
+    def train(self, ckpt_dir: str | None = None, metrics_logger=None):
+        cfg = self.cfg
+        for r in range(cfg.comm_round):
+            m = self._inner.train_one_round(r)
+            rec = {"round": r, **{k: float(v) for k, v in m.items()}}
+            if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+                ev = self.evaluate()
+                rec.update({f"Test/{k}": v for k, v in ev.__dict__.items()})
+            self.history.append(rec)
+            if metrics_logger is not None:
+                metrics_logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)
+            if ckpt_dir:
+                self._inner.save_checkpoint(ckpt_dir, r + 1)
+        return self.history
+
+    def evaluate(self) -> EvaluationMetricsKeeper:
+        """Global-test-set segmentation scores (reference
+        FedSegAggregator.output_global_acc_and_loss:160-199)."""
+        bx, by, bm = self._inner._test_batches
+        cm, loss = self._cm_fn(self.global_variables, jnp.asarray(bx),
+                               jnp.asarray(by), jnp.asarray(bm))
+        scores = evaluator_scores(cm)
+        loss = float(loss)
+        return EvaluationMetricsKeeper(
+            accuracy=scores["Acc"], accuracy_class=scores["Acc_class"],
+            mIoU=scores["mIoU"], FWIoU=scores["FWIoU"], loss=loss)
 
 
 # -------------------------------------------------------------- lr schedule

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,6 +27,32 @@ import optax
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
+
+
+class SplitLowerCNN(nn.Module):
+    """Client-side lower half: conv feature extractor (the reference splits
+    an arch's `nn.Sequential` at split_layer, split_nn/client.py:10-22)."""
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(self.width, (3, 3), padding=1, name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(nn.Conv(2 * self.width, (3, 3), padding=1, name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        return x
+
+
+class SplitUpperCNN(nn.Module):
+    """Server-side upper half: classifier head over client activations."""
+    output_dim: int = 10
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, acts, train: bool = False):
+        x = acts.reshape(acts.shape[0], -1)
+        x = nn.relu(nn.Dense(self.hidden, name="fc1")(x))
+        return nn.Dense(self.output_dim, name="fc2")(x)
 
 
 def make_splitnn_optimizer(cfg: FedConfig, momentum: float | None = None,

@@ -200,7 +200,22 @@ class SecureAggregator:
         self.rng = np.random.RandomState(seed)
 
     def secure_weighted_sum(self, client_trees: list, weights: np.ndarray):
-        """Returns the weighted average pytree, computed only from shares."""
+        """Returns the weighted average pytree, computed only from shares —
+        the single-group case of the circular aggregation below."""
+        return self.secure_weighted_sum_grouped(client_trees, weights, 1)
+
+    def secure_weighted_sum_grouped(self, client_trees: list, weights: np.ndarray,
+                                    num_groups: int):
+        """Multi-group circular aggregation (reference TurboAggregate topology,
+        TA_decentralized_worker_manager.py:8 — workers forward partial
+        aggregates to ring neighbors). Clients are split into `num_groups`
+        ring-ordered groups; each group adds its members' Shamir shares onto
+        the share-space partial aggregate received from the previous group, so
+        plaintext updates never leave a client and intermediate aggregates
+        exist only as shares. The final group's accumulated shares are
+        reconstructed once. num_groups=1 is the flat secure sum."""
+        if num_groups < 1:
+            raise ValueError("num_groups must be >= 1")
         w = np.asarray(weights, np.float64)
         w = w / w.sum()
         # weight in fixed point too: scale each client's quantized vec by w_i
@@ -234,17 +249,96 @@ class SecureAggregator:
                 f"{self.p // 2}; reduce frac_bits ({self.frac_bits}) or weight "
                 f"resolution (2^{res_bits})"
             )
-        share_sum = None
-        for vec, wi in zip(qvecs, wq):
-            masked = np.mod(vec * wi, self.p)[None, :]  # [1, n]
-            shares = bgw_encoding(masked.T, self.n, self.t, self.p, self.rng)  # [N, n, 1]
-            share_sum = shares if share_sum is None else np.mod(share_sum + shares, self.p)
-        # reconstruct from T+1 of the summed shares — individual updates never leave the field
+        # ring traversal: group g adds its members' shares onto the running
+        # share-space aggregate received from group g-1; only the last hop's
+        # accumulated shares are ever reconstructed
+        groups = np.array_split(np.arange(len(client_trees)), num_groups)
+        share_total = None
+        for members in groups:
+            group_shares = None
+            for i in members:
+                masked = np.mod(qvecs[i] * wq[i], self.p)[None, :]  # [1, n]
+                s = bgw_encoding(masked.T, self.n, self.t, self.p, self.rng)  # [N, n, 1]
+                group_shares = s if group_shares is None else np.mod(group_shares + s, self.p)
+            if group_shares is not None:
+                share_total = (group_shares if share_total is None
+                               else np.mod(share_total + group_shares, self.p))
+        # reconstruct from T+1 of the summed shares — individual updates never
+        # leave the field
         idx = list(range(self.t + 1))
-        dec = bgw_decoding(share_sum[: self.t + 1], idx, self.p)[0]  # [n, 1]
+        dec = bgw_decoding(share_total[: self.t + 1], idx, self.p)[0]  # [n, 1]
         total = np.mod(dec[:, 0], self.p)
         # normalize by the ACTUAL rounded-weight sum (sum(round(w*256)) is
         # generally != 256, which would otherwise scale the model each round)
         out = dequantize_vector(total, client_trees[0], self.frac_bits, self.p)
-        scale = 1.0 / float(wq.sum())
-        return jax.tree.map(lambda l: l * scale, out)
+        return jax.tree.map(lambda l: l * (1.0 / float(wq.sum())), out)
+
+
+class TurboAggregateAPI:
+    """Runnable TurboAggregate federated training (reference TA_API.py +
+    TA_trainer.py): FedAvg local training via the shared engine, server
+    aggregation through the secure multi-group circular sum — the server only
+    ever sees Shamir shares and the reconstructed average."""
+
+    def __init__(self, dataset, cfg, model_trainer, num_groups: int = 2,
+                 threshold: int | None = None, frac_bits: int = 16):
+        import jax.numpy as jnp
+
+        from fedml_tpu.algorithms.engine import build_eval_fn, build_local_update
+
+        self.dataset = dataset
+        self.cfg = cfg
+        self.trainer = model_trainer
+        self.num_groups = num_groups
+        k = min(cfg.client_num_per_round, dataset.client_num)
+        self.agg = SecureAggregator(num_clients=k, threshold=threshold,
+                                    frac_bits=frac_bits, seed=cfg.seed)
+        local_update = build_local_update(model_trainer, cfg)
+        self._local = jax.jit(jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0)))
+        self._eval = build_eval_fn(model_trainer)
+        rng = jax.random.PRNGKey(cfg.seed)
+        example = jnp.asarray(dataset.train.x[:1, 0])
+        self.global_variables = model_trainer.init(rng, example)
+        from fedml_tpu.data.packing import pack_eval_batches
+
+        bs = cfg.batch_size if cfg.batch_size > 0 else 256
+        self._test_batches = pack_eval_batches(*dataset.test_global, max(bs, 64))
+        self.history: list[dict] = []
+
+    def train_one_round(self, round_idx: int) -> dict:
+        import jax.numpy as jnp
+
+        from fedml_tpu.algorithms.fedavg import client_sampling
+
+        cfg = self.cfg
+        idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
+        x, y, counts = self.dataset.train.select(idx)
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
+        crngs = jax.random.split(rng, len(idx))
+        result = self._local(self.global_variables, jnp.asarray(x), jnp.asarray(y),
+                             jnp.asarray(counts), crngs)
+        trees = [jax.tree.map(lambda l, i=i: np.asarray(l[i]), result.variables)
+                 for i in range(len(idx))]
+        self.global_variables = self.agg.secure_weighted_sum_grouped(
+            trees, counts.astype(np.float64), self.num_groups)
+        m = {k: float(v.sum()) for k, v in result.metrics.items()}
+        total = max(m.get("total", 1.0), 1.0)
+        return {"Train/Acc": m.get("correct", 0.0) / total,
+                "Train/Loss": m.get("loss_sum", 0.0) / total}
+
+    def train(self, metrics_logger=None) -> list[dict]:
+        import jax.numpy as jnp
+
+        for r in range(self.cfg.comm_round):
+            rec = {"round": r, **self.train_one_round(r)}
+            bx, by, bm = self._test_batches
+            ev = self._eval(self.global_variables, jnp.asarray(bx),
+                            jnp.asarray(by), jnp.asarray(bm))
+            ev = {k: float(v) for k, v in ev.items()}
+            tot = max(ev.get("test_total", 1.0), 1.0)
+            rec["Test/Acc"] = ev.get("test_correct", 0.0) / tot
+            rec["Test/Loss"] = ev.get("test_loss", 0.0) / tot
+            self.history.append(rec)
+            if metrics_logger is not None:
+                metrics_logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)
+        return self.history

@@ -1,0 +1,47 @@
+"""FedGKT experiment main (reference fedml_experiments/distributed/fedgkt/
+main_fedgkt.py: edge CNN + server ResNet group knowledge transfer).
+
+Usage:
+  python -m fedml_tpu.experiments.main_fedgkt --dataset cifar10 \
+      --client_num_in_total 8 --comm_round 10 --epochs 1 --epochs_server 2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    # reference main_fedgkt flags (--epochs_server, --temperature, --alpha)
+    parser.add_argument("--epochs_server", type=int, default=2)
+    parser.add_argument("--temperature", type=float, default=3.0)
+    parser.add_argument("--alpha", type=float, default=1.0)
+    parser.add_argument("--client_blocks", type=int, default=1)
+    parser.add_argument("--server_blocks", type=int, nargs=3, default=None)
+    args = parser.parse_args(argv)
+    cfg, ds, _trainer = setup_run(args)
+    client = GKTClientResNet(output_dim=ds.class_num, num_blocks=args.client_blocks)
+    server_kw = {"output_dim": ds.class_num}
+    if args.server_blocks:
+        server_kw["layers"] = tuple(args.server_blocks)
+    server = GKTServerResNet(**server_kw)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = FedGKTAPI(ds, cfg, client, server, alpha=args.alpha,
+                    temperature=args.temperature, server_epochs=args.epochs_server)
+    history = api.train()
+    final = api.evaluate()
+    for r, rec in enumerate(history):
+        logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)
+    logger.log(final, step=len(history))
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""FedSeg experiment main (reference fedml_api/distributed/fedseg consumed
+via its API; the fork ships no launcher — this one mirrors the FedAvg main
+flags plus the segmentation extras from fedseg/utils.py).
+
+Usage:
+  python -m fedml_tpu.experiments.main_fedseg --dataset pascal_voc \
+      --model deeplab --client_num_in_total 4 --comm_round 3 --loss_type ce
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.fedseg import FedSegAPI, SegmentationTrainer
+from fedml_tpu.experiments.common import add_args, config_from_args
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    parser.add_argument("--loss_type", type=str, default="ce",
+                        choices=["ce", "focal"])
+    parser.add_argument("--image_size", type=int, default=32)
+    parser.add_argument("--model_width", type=int, default=16)
+    parser.set_defaults(dataset="pascal_voc", model="deeplab",
+                        partition_method="homo", client_num_in_total=4,
+                        client_num_per_round=4)
+    args = parser.parse_args(argv)
+
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.registry import create_model
+
+    cfg = config_from_args(args)
+    ds = load_dataset(args.dataset, data_dir=args.data_dir,
+                      client_num_in_total=args.client_num_in_total,
+                      partition_method=args.partition_method,
+                      partition_alpha=args.partition_alpha,
+                      image_size=args.image_size, seed=args.seed)
+    module = create_model(args.model, output_dim=ds.class_num,
+                          width=args.model_width)
+    trainer = SegmentationTrainer(module, loss_type=args.loss_type)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = FedSegAPI(ds, cfg, trainer)
+    history = api.train(metrics_logger=logger)
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""SplitNN experiment main (reference fedml_experiments/distributed/split_nn/
+main_split_nn.py: round-robin split learning over a client pool).
+
+Usage:
+  python -m fedml_tpu.experiments.main_split_nn --dataset cifar10 \
+      --client_num_in_total 4 --comm_round 5 --epochs 1 --batch_size 32
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algorithms.splitnn import SplitLowerCNN, SplitNNAPI, SplitUpperCNN
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    parser.add_argument("--split_width", type=int, default=16)
+    args = parser.parse_args(argv)
+    cfg, ds, _trainer = setup_run(args)
+    lower = SplitLowerCNN(width=args.split_width)
+    upper = SplitUpperCNN(output_dim=ds.class_num)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+    api = SplitNNAPI(ds, cfg, lower, upper)
+    history = api.train()
+    final = api.evaluate()
+    for r, rec in enumerate(history):
+        logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)
+    logger.log(final, step=len(history))
+    logger.finish()
+    return history
+
+
+if __name__ == "__main__":
+    main()
