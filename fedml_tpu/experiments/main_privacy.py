@@ -1,0 +1,139 @@
+"""Privacy experiment main (reference privacy_fedml/main_fedavg.py:1-552 —
+the fork's raison d'etre: branch/ensemble FedAvg + membership-inference
+attack evaluation). Flags mirror the reference surface (:100-135):
+--branch_num, --ensemble_method, --server_data_ratio, --feat_lmda,
+--no_mi_attack.
+
+Ensemble methods: predavg / predvote / predweight / blockavg / hetero via
+BranchFedAvgAPI (privacy/branch_fedavg.py); blockensemble via the true
+block-mixing BlockEnsembleAPI (privacy/blockensemble.py) whose clients run
+TwoModelTrainer/ThreeModelTrainer joint training (--num_paths 2|3).
+
+Usage:
+  python -m fedml_tpu.experiments.main_privacy --dataset mnist \
+      --branch_num 4 --ensemble_method blockensemble --comm_round 3
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.experiments.common import add_args, config_from_args
+from fedml_tpu.utils.logging import MetricsLogger
+
+
+def run_mi_attacks(predict_fn, trainer, variables, member, nonmember):
+    """Shadow-NN + loss + gradient-norm membership attacks on the final
+    model (reference privacy_fedml/MI_attack/*; privacy/mi_attack.py)."""
+    from fedml_tpu.privacy.mi_attack import (
+        NNAttack,
+        gradient_norm_attack,
+        loss_attack,
+        make_per_sample_grad_norm,
+        make_per_sample_loss,
+    )
+
+    (mx, my), (nx, ny) = member, nonmember
+    out = {}
+    nn_attack = NNAttack(top_k=3)
+    nn_attack.fit(predict_fn, mx, nx)
+    out.update({f"MI/NN_{k}": v for k, v in
+                nn_attack.score(predict_fn, mx, nx).items()})
+    if trainer is not None and variables is not None:
+        loss_fn = make_per_sample_loss(trainer, variables)
+        out.update({f"MI/Loss_{k}": v for k, v in
+                    loss_attack(loss_fn, (mx, my), (nx, ny)).items()})
+        gn_fn = make_per_sample_grad_norm(trainer, variables)
+        out.update({f"MI/GradNorm_{k}": v for k, v in
+                    gradient_norm_attack(gn_fn, (mx, my), (nx, ny)).items()})
+    return out
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser())
+    # reference privacy_fedml/main_fedavg.py:122-134
+    parser.add_argument("--branch_num", type=int, default=4)
+    parser.add_argument("--ensemble_method", type=str, default="predavg",
+                        choices=["predavg", "predvote", "predweight",
+                                 "blockavg", "hetero", "blockensemble"])
+    parser.add_argument("--server_data_ratio", type=float, default=0.1)
+    parser.add_argument("--feat_lmda", type=float, default=0.0)
+    parser.add_argument("--num_paths", type=int, default=2,
+                        help="2 = TwoModelTrainer, 3 = ThreeModelTrainer "
+                             "(blockensemble client joint training)")
+    parser.add_argument("--no_mi_attack", action="store_true")
+    parser.add_argument("--shared_blocks", type=str, nargs="*", default=None)
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args)
+
+    from fedml_tpu.data.registry import load_dataset
+
+    # AdaptiveCNN branches operate on images — keep mnist/fmnist unflattened
+    ds = load_dataset(args.dataset, data_dir=args.data_dir,
+                      client_num_in_total=args.client_num_in_total,
+                      partition_method=args.partition_method,
+                      partition_alpha=args.partition_alpha, seed=args.seed,
+                      flatten=False)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+
+    trainer_for_mi = None
+    vars_for_mi = None
+    if args.ensemble_method == "blockensemble":
+        from fedml_tpu.privacy.blockensemble import BlockEnsembleAPI
+
+        api = BlockEnsembleAPI(ds, cfg, branch_num=args.branch_num,
+                               num_paths=args.num_paths,
+                               feat_lmda=args.feat_lmda)
+        api.train(metrics_logger=logger)
+        predict_fn = lambda x: jnp.log(api.branch_probs(x).mean(axis=0) + 1e-9)
+    else:
+        from fedml_tpu.models.ensemble import AdaptiveCNN, ArchSpec, build_hetero_archs
+        from fedml_tpu.privacy.branch_fedavg import BranchFedAvgAPI
+
+        if args.ensemble_method == "hetero":
+            archs = build_hetero_archs(args.branch_num)
+        else:
+            archs = [ArchSpec()] * args.branch_num
+        trainers = [ClassificationTrainer(
+            AdaptiveCNN(output_dim=ds.class_num, arch=a)) for a in archs]
+        shared = (tuple(args.shared_blocks) if args.shared_blocks
+                  else (("conv1_out", "conv2_out")
+                        if args.ensemble_method == "blockavg" else ()))
+        api = BranchFedAvgAPI(ds, cfg, trainers,
+                              ensemble_method=args.ensemble_method,
+                              shared_blocks=shared,
+                              server_data_ratio=args.server_data_ratio)
+        history = api.train()
+        for rec in history:
+            logger.log({k: v for k, v in rec.items() if k != "round"},
+                       step=rec["round"])
+        trainer_for_mi = trainers[0]
+        vars_for_mi = api.branches[0]
+        predict_fn = lambda x: jnp.log(api.branch_probs(x).mean(axis=0) + 1e-9)
+
+    final = api.evaluate()
+    logger.log(final, step=cfg.comm_round)
+
+    if not args.no_mi_attack:
+        # members = training samples seen by the federation; nonmembers =
+        # held-out test samples (reference MI eval split)
+        xtr, ytr = ds.train_global
+        xte, yte = ds.test_global
+        k = min(len(ytr), len(yte), 512)
+        member = (jnp.asarray(xtr[:k]), jnp.asarray(ytr[:k]))
+        nonmember = (jnp.asarray(xte[:k]), jnp.asarray(yte[:k]))
+        mi = run_mi_attacks(predict_fn, trainer_for_mi, vars_for_mi,
+                            member, nonmember)
+        logger.log(mi, step=cfg.comm_round)
+        final.update(mi)
+
+    logger.finish()
+    return api.history, final
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""Multi-model joint client training (reference privacy_fedml
+two_model_trainer.py:15-140 / three_model_trainer.py: a client trains 2-3
+branch models TOGETHER on its local data — one optimizer over the union of
+parameters, loss = sum of per-model CE + `feat_lmda` x MSE between the
+models' block features — then ships every model back for branch-wise
+aggregation).
+
+TPU design: the K models are K stacked variable trees of one module; the
+joint step is a single jitted scan over minibatches (same shuffle-in-jit
+trick as algorithms/engine.py), vmapped over clients. Feature matching uses
+flax `capture_intermediates` on the fixed-width block outputs (conv1_out /
+conv2_out / linear1_out — equal dims across branches by AdaptiveCNN's
+design), the analog of the reference's `feature_forward` hooks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms.engine import make_local_optimizer
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.pytree import tree_where
+
+FEATURE_SOWS = ("conv1_out", "conv2_out", "linear1_out")
+
+
+def _forward_with_features(module, variables, x, rng, train: bool):
+    """(logits, [block features]) — capture the fixed-width block outputs."""
+    kwargs = {"rngs": {"dropout": rng}} if (train and rng is not None) else {}
+    out, inter = module.apply(
+        variables, x, train=train,
+        capture_intermediates=lambda mdl, _name: mdl.name in FEATURE_SOWS,
+        mutable=["intermediates"], **kwargs)
+    feats = [v["__call__"][0]
+             for _k, v in sorted(inter["intermediates"].items())]
+    return out, feats
+
+
+def build_joint_local_update(module, cfg: FedConfig, num_models: int,
+                             feat_lmda: float = 0.0) -> Callable:
+    """Returns local_update(paths, x, y, count, rng) -> (paths, metrics):
+    `paths` is a tuple of `num_models` variable trees trained jointly.
+
+    Optimizer matches the reference joint construction (two_model_trainer.py
+    :82-91: one SGD/Adam over chain(model1.parameters(), model2.parameters())
+    with grad clip 1.0 per model) — optax treats the tuple-of-trees as one
+    pytree, which is exactly `chain(...)`.
+    """
+    opt = make_local_optimizer(cfg)
+
+    def joint_loss(paths, bx, by, bmask, rng):
+        n = jnp.maximum(bmask.sum(), 1.0)
+        total, correct = 0.0, 0.0
+        feats_all = []
+        for k, v in enumerate(paths):
+            logits, feats = _forward_with_features(
+                module, v, bx, jax.random.fold_in(rng, k), train=True)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+            total = total + (per * bmask).sum() / n
+            correct = correct + ((jnp.argmax(logits, -1) == by) * bmask).sum()
+            feats_all.append(feats)
+        if feat_lmda != 0.0 and num_models > 1:
+            reg = 0.0
+            m4 = lambda f: bmask.reshape((-1,) + (1,) * (f.ndim - 1))
+            for a in range(num_models):
+                for b in range(a + 1, num_models):
+                    for fa, fb in zip(feats_all[a], feats_all[b]):
+                        reg = reg + (jnp.square(fa - fb) * m4(fa)).sum() / (
+                            n * fa[0].size)
+            total = total + feat_lmda * reg
+        return total, correct
+
+    def local_update(paths, x, y, count, rng):
+        n_max = x.shape[0]
+        b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
+        nb = math.ceil(n_max / b)
+        n_pad = nb * b
+        opt_state = opt.init(tuple(paths))
+
+        def epoch_body(carry, erng):
+            paths, opt_state = carry
+            shuffle_rng, step_rng = jax.random.split(erng)
+            u = jax.random.uniform(shuffle_rng, (n_max,))
+            valid = jnp.arange(n_max) < count
+            perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+            if n_pad > n_max:
+                perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
+            xe = jnp.take(x, perm, 0).reshape((nb, b) + x.shape[1:])
+            ye = jnp.take(y, perm, 0).reshape((nb, b) + y.shape[1:])
+            bvalid = ((jnp.arange(n_pad) < count).reshape(nb, b)
+                      .astype(jnp.float32))
+
+            def step_body(carry, sin):
+                paths, opt_state = carry
+                bx, by, bm, srng = sin
+                (loss, correct), grads = jax.value_and_grad(
+                    joint_loss, has_aux=True)(paths, bx, by, bm, srng)
+                upd, new_opt = opt.update(grads, opt_state, paths)
+                new_paths = optax.apply_updates(paths, upd)
+                has = jnp.any(bm > 0)
+                paths = tree_where(has, new_paths, paths)
+                opt_state = tree_where(has, new_opt, opt_state)
+                return (paths, opt_state), (loss * bm.sum(), correct, bm.sum())
+
+            srngs = jax.random.split(step_rng, nb)
+            (paths, opt_state), ms = jax.lax.scan(
+                step_body, (paths, opt_state), (xe, ye, bvalid, srngs))
+            return (paths, opt_state), tuple(m.sum() for m in ms)
+
+        (paths, _), (loss_n, correct, n) = jax.lax.scan(
+            epoch_body, (tuple(paths), opt_state),
+            jax.random.split(rng, cfg.epochs))
+        metrics = {"loss_sum": loss_n.sum(),
+                   "correct": correct.sum() / num_models,
+                   "total": n.sum()}
+        return paths, metrics
+
+    return local_update
+
+
+class TwoModelTrainer:
+    """Reference two_model_trainer.py surface: train two branch models
+    jointly on one client's data."""
+
+    def __init__(self, module, cfg: FedConfig, feat_lmda: float = 0.0):
+        self.module = module
+        self.num_models = 2
+        self._update = jax.jit(
+            build_joint_local_update(module, cfg, 2, feat_lmda))
+
+    def train(self, paths: Sequence, x, y, count, rng):
+        assert len(paths) == self.num_models
+        return self._update(tuple(paths), x, y, count, rng)
+
+
+class ThreeModelTrainer(TwoModelTrainer):
+    """Reference three_model_trainer.py: same, three models jointly."""
+
+    def __init__(self, module, cfg: FedConfig, feat_lmda: float = 0.0):
+        self.module = module
+        self.num_models = 3
+        self._update = jax.jit(
+            build_joint_local_update(module, cfg, 3, feat_lmda))

@@ -18,6 +18,12 @@ def mnist8():
     return load_dataset("mnist", client_num_in_total=8, partition_method="homo", seed=0)
 
 
+@pytest.fixture(scope="module")
+def mnist8_img():
+    return load_dataset("mnist", client_num_in_total=8, partition_method="homo",
+                        seed=0, flatten=False)
+
+
 def test_adaptive_cnn_variants_forward():
     x = jnp.zeros((2, 28, 28, 1))
     for spec in build_hetero_archs(6):
@@ -53,6 +59,7 @@ def test_blockavg_shares_blocks(mnist8):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
 
 
+@pytest.mark.slow
 def test_hetero_ensemble_branches(mnist8):
     import dataclasses
     ds = mnist8
@@ -163,3 +170,119 @@ def test_pgd_attack_reduces_accuracy():
     accs = robust_accuracy(predict, x, y, [0.0, 0.5], attack="pgd", steps=5)
     assert accs[0.0] > 0.8
     assert accs[0.5] < accs[0.0]  # attack hurts
+
+
+# ------------------------------------------------- multi-model / blockensemble
+
+
+def test_joint_local_update_trains_two_models(mnist8_img):
+    """TwoModelTrainer semantics: both paths improve on the client's data and
+    the feature-matching term pulls block features together."""
+    from fedml_tpu.privacy.multi_model import TwoModelTrainer, _forward_with_features
+
+    cfg = FedConfig(comm_round=1, epochs=2, batch_size=16, lr=0.1,
+                    client_num_in_total=8, client_num_per_round=8)
+    module = AdaptiveCNN(output_dim=10)
+    rng = jax.random.PRNGKey(0)
+    x, y, counts = mnist8_img.train.select(np.array([0]))
+    n_cap = 48  # keep the joint compile cheap on CI boxes
+    x0, y0 = jnp.asarray(x[0][:n_cap]), jnp.asarray(y[0][:n_cap])
+    c0 = jnp.minimum(jnp.asarray(counts[0]), n_cap)
+    paths = tuple(
+        module.init({"params": jax.random.fold_in(rng, b), "dropout": rng},
+                    x0[:1], train=False)
+        for b in range(2)
+    )
+    tm = TwoModelTrainer(module, cfg, feat_lmda=0.0)
+    new_paths, m = tm.train(paths, x0, y0, c0, rng)
+    assert float(m["total"]) == cfg.epochs * int(c0)
+    # both models moved
+    for old, new in zip(paths, new_paths):
+        diff = max(float(jnp.max(jnp.abs(a - b)))
+                   for a, b in zip(jax.tree.leaves(old), jax.tree.leaves(new)))
+        assert diff > 1e-6
+    # feature matching reduces inter-model feature distance vs no matching
+    tm_reg = TwoModelTrainer(module, cfg, feat_lmda=10.0)
+    reg_paths, _ = tm_reg.train(paths, x0, y0, c0, rng)
+
+    def feat_dist(ps):
+        _, fa = _forward_with_features(module, ps[0], x0[:16], None, train=False)
+        _, fb = _forward_with_features(module, ps[1], x0[:16], None, train=False)
+        return sum(float(jnp.mean(jnp.square(a - b))) for a, b in zip(fa, fb))
+
+    assert feat_dist(reg_paths) < feat_dist(new_paths)
+
+
+def test_blockensemble_round_updates_only_drawn_blocks(mnist8_img):
+    """Reference average_updated_branch_params: a (branch, block) pair not
+    drawn this round keeps its previous params; drawn ones change."""
+    from fedml_tpu.privacy.blockensemble import BLOCKS, BlockEnsembleAPI, block_of
+
+    import dataclasses
+
+    from fedml_tpu.data.packing import PackedClients
+
+    n_cap = 48  # keep the joint compile cheap on CI boxes
+    ds = dataclasses.replace(
+        mnist8_img,
+        train=PackedClients(mnist8_img.train.x[:, :n_cap],
+                            mnist8_img.train.y[:, :n_cap],
+                            np.minimum(mnist8_img.train.counts, n_cap)),
+        test_global=(mnist8_img.test_global[0][:200],
+                     mnist8_img.test_global[1][:200]),
+    )
+    cfg = FedConfig(comm_round=1, epochs=1, batch_size=16, lr=0.1,
+                    client_num_in_total=8, client_num_per_round=4)
+    api = BlockEnsembleAPI(ds, cfg, branch_num=3, num_paths=2)
+    before = [jax.tree.map(lambda l: np.asarray(l).copy(), b)
+              for b in api.branches]
+    _, pick = api.prepare_paths(0)
+    api.train_one_round(0)
+    for b in range(3):
+        for name in api.branches[b]["params"]:
+            blk = block_of(name)
+            drawn = b in set(int(v) for v in pick[blk])
+            changed = any(
+                float(np.max(np.abs(np.asarray(l1) - np.asarray(l2)))) > 1e-7
+                for l1, l2 in zip(jax.tree.leaves(before[b]["params"][name]),
+                                  jax.tree.leaves(api.branches[b]["params"][name]))
+            )
+            assert changed == drawn, (b, name, drawn)
+    ev = api.evaluate()
+    assert 0.0 <= ev["Ensemble/Acc"] <= 1.0 and "Branch2/Acc" in ev
+
+
+@pytest.mark.slow
+def test_main_privacy_cli_blockensemble(tmp_path):
+    import json
+
+    from fedml_tpu.experiments.main_privacy import main
+
+    _hist, final = main([
+        "--dataset", "mnist", "--partition_method", "homo",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--comm_round", "1", "--epochs", "1", "--batch_size", "32",
+        "--lr", "0.1", "--branch_num", "3", "--ensemble_method",
+        "blockensemble", "--run_dir", str(tmp_path / "run"),
+    ])
+    summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())
+    assert "Ensemble/Acc" in summary
+    assert "Branch0/Acc" in summary
+    assert "MI/NN_attack_acc" in summary  # MI-attack report present
+
+
+@pytest.mark.slow
+def test_main_privacy_cli_predweight(tmp_path):
+    import json
+
+    from fedml_tpu.experiments.main_privacy import main
+
+    _hist, final = main([
+        "--dataset", "mnist", "--partition_method", "homo",
+        "--comm_round", "1", "--epochs", "1", "--batch_size", "32",
+        "--lr", "0.1", "--branch_num", "2", "--ensemble_method", "predweight",
+        "--no_mi_attack", "--client_num_in_total", "8",
+        "--client_num_per_round", "4", "--run_dir", str(tmp_path / "run"),
+    ])
+    summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())
+    assert "Ensemble/Acc" in summary and "Branch1/Acc" in summary
