@@ -1,0 +1,99 @@
+"""Worker for the 2-process jax.distributed CPU test (run by
+tests/test_multihost.py). Each process owns 4 virtual CPU devices; the two
+form one 8-device global mesh — the cross-silo deployment shape of
+fedml_tpu.parallel.multihost (the mpirun replacement, SURVEY §2.9).
+
+Exercises the control plane (broadcast_from_server, allgather_metrics,
+assert_same_across_processes, round_barrier) and one sharded FedAvg round
+whose clients span both processes, asserting the result is identical on
+every process.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from fedml_tpu.parallel.multihost import (
+        allgather_metrics,
+        assert_same_across_processes,
+        broadcast_from_server,
+        init_multihost,
+        round_barrier,
+    )
+
+    info = init_multihost(f"localhost:{port}", nproc, pid)
+    assert info["process_count"] == 2, info
+    assert info["global_device_count"] == 8, info
+    assert info["local_device_count"] == 4, info
+
+    # ---- control plane (DCN collectives replacing MPI messages)
+    local = np.arange(4, dtype=np.int32) + (100 if pid == 0 else -7)
+    got = np.asarray(broadcast_from_server(local))
+    assert (got == np.arange(4) + 100).all(), got  # process-0 value wins
+
+    m = allgather_metrics({"correct": 1.0 + pid, "total": 10.0})
+    assert m["correct"] == 3.0 and m["total"] == 20.0, m
+
+    assert_same_across_processes(np.asarray([42, 43]), "sanity")
+    round_barrier("test", 0)
+
+    # ---- one sharded round with clients spanning both processes
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+    from fedml_tpu.parallel.sharded import build_sharded_round_fn
+
+    C, n_max, dim, classes = 8, 16, 12, 4
+    cfg = FedConfig(comm_round=1, epochs=1, batch_size=8, lr=0.1,
+                    client_num_in_total=C, client_num_per_round=C)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=classes))
+    rng = np.random.RandomState(0)  # same seed both processes -> same data
+    x_all = rng.rand(C, n_max, dim).astype(np.float32)
+    y_all = rng.randint(0, classes, size=(C, n_max)).astype(np.int32)
+    counts = np.full(C, n_max, np.int32)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(C), ("clients",))
+    agg = make_aggregator("fedavg", cfg)
+    round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
+
+    variables = trainer.init(jax.random.PRNGKey(0), jnp.asarray(x_all[:1, 0]))
+    sh = NamedSharding(mesh, P("clients"))
+    lo, hi = pid * (C // nproc), (pid + 1) * (C // nproc)
+    gx = jax.make_array_from_process_local_data(sh, x_all[lo:hi], x_all.shape)
+    gy = jax.make_array_from_process_local_data(sh, y_all[lo:hi], y_all.shape)
+    gc = jax.make_array_from_process_local_data(sh, counts[lo:hi], counts.shape)
+
+    new_global, _, metrics = round_fn(variables, agg.init_state(variables),
+                                      gx, gy, gc, jax.random.PRNGKey(7))
+    jax.block_until_ready(new_global)
+    assert float(metrics["total"]) == C * n_max, metrics
+
+    # the aggregated model must be identical on every process
+    leaf = np.asarray(new_global["params"]["linear"]["kernel"])
+    assert np.all(np.isfinite(leaf))
+    assert_same_across_processes(leaf.astype(np.float32), "aggregated_kernel")
+    # and training moved it
+    init_leaf = np.asarray(variables["params"]["linear"]["kernel"])
+    assert np.abs(leaf - init_leaf).max() > 1e-6
+
+    round_barrier("test", 1)
+    print(f"MULTIHOST_OK pid={pid}")
+
+
+if __name__ == "__main__":
+    main()

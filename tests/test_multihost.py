@@ -1,0 +1,46 @@
+"""2-process jax.distributed CPU test (VERDICT r1 item 9): proves the
+multihost control plane and a cross-process sharded round without TPUs.
+Spawns two subprocesses with a local coordinator; each owns 4 virtual CPU
+devices of one 8-device global mesh."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_round():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = dict(os.environ)
+    env.pop("PYTHONSTARTUP", None)
+    # the worker sets its own JAX_PLATFORMS/XLA_FLAGS before importing jax;
+    # strip any inherited device-count forcing so 4-per-process sticks
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    procs = [
+        subprocess.Popen([sys.executable, worker, str(pid), "2", str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out:\n" + "\n".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MULTIHOST_OK pid={pid}" in out, out
