@@ -83,17 +83,23 @@ class HierarchicalFLAPI:
             idx = np.random.RandomState(cfg.seed).permutation(dataset.client_num)
             group_assignment = [np.sort(a) for a in np.array_split(idx, group_num)]
         self.groups = group_assignment
-        sizes = {len(g) for g in self.groups}
-        if len(sizes) != 1:
-            raise ValueError(
-                f"groups must be equal-sized for the vmapped group axis, got {sorted(len(g) for g in self.groups)}"
-            )
+        if any(len(g) == 0 for g in self.groups):
+            raise ValueError("every group needs at least one client")
         self.round_fn = build_hierarchical_round_fn(trainer, cfg, group_comm_round)
         self.eval_fn = build_eval_fn(trainer)
-        # group assignment is fixed — stack [G, C, ...] arrays once, not per round
+        # group assignment is fixed — stack [G, C, ...] arrays once, not per
+        # round. Ragged groups (the reference accepts arbitrary splits,
+        # group.py:24-46) are padded to the largest group with zero-count
+        # clients — weight-0 no-ops in both averaging levels.
+        c_max = max(len(g) for g in self.groups)
         xs, ys, cs = [], [], []
         for g in self.groups:
             x, y, c = dataset.train.select(g)
+            pad = c_max - len(g)
+            if pad:
+                x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+                c = np.concatenate([c, np.zeros(pad, c.dtype)])
             xs.append(x); ys.append(y); cs.append(c)
         self._x = jnp.asarray(np.stack(xs))
         self._y = jnp.asarray(np.stack(ys))

@@ -106,3 +106,90 @@ class VerticalFederatedLearningAPI:
 
     def score(self, X, y) -> float:
         return float(np.mean((self.predict_proba(X) > 0.5).astype(int) == y))
+
+
+# --------------------------------------------------------------- neural VFL
+
+
+def build_neural_vfl_step(lr: float = 0.01, momentum: float = 0.9,
+                          wd: float = 0.01) -> Callable:
+    """Neural party stack step (reference fedml_api/model/finance/
+    vfl_models_standalone.py:6-75 + party_models.py:12-118): each party runs
+    LocalModel (Dense + LeakyReLU feature extractor) then DenseModel
+    (feature -> scalar logit component); the guest (party 0, bias=True — the
+    hosts' dense models have bias=False) sums components, takes
+    BCE-with-logits, and `jax.grad` through the sum delivers every party's
+    common-gradient update. Optimizer matches the reference's
+    SGD(momentum=0.9, weight_decay=0.01) on every sub-model."""
+    opt = optax.chain(optax.add_decayed_weights(wd), optax.sgd(lr, momentum=momentum))
+
+    def party_logit(p, x):
+        z = jax.nn.leaky_relu(x @ p["local_w"] + p["local_b"])
+        u = z @ p["dense_w"][:, 0]
+        if "dense_b" in p:
+            u = u + p["dense_b"][0]
+        return u
+
+    def step(params_list, opt_state, xs, y):
+        def loss_fn(params_list):
+            u = jnp.zeros((y.shape[0],), jnp.float32)
+            for p, x in zip(params_list, xs):
+                u = u + party_logit(p, x)
+            per = optax.sigmoid_binary_cross_entropy(u, y.astype(jnp.float32))
+            return per.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(tuple(params_list))
+        upd, opt_state = opt.update(grads, opt_state, tuple(params_list))
+        return optax.apply_updates(tuple(params_list), upd), opt_state, loss
+
+    return jax.jit(step), party_logit, opt
+
+
+class NeuralVFLAPI:
+    """Vertical FL with the reference's neural party models (LocalModel
+    feature extractors + DenseModel components — the 'VFL finance models'
+    row of SURVEY §2.5). Party 0 is the guest (label owner)."""
+
+    def __init__(self, party_dims: list[int], hidden_dim: int = 32,
+                 lr: float = 0.01, momentum: float = 0.9, wd: float = 0.01,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.params: list[dict] = []
+        for k, d in enumerate(party_dims):
+            p = {
+                "local_w": jnp.asarray(rng.normal(0, np.sqrt(2.0 / d),
+                                                  (d, hidden_dim)).astype(np.float32)),
+                "local_b": jnp.zeros((hidden_dim,), jnp.float32),
+                "dense_w": jnp.asarray(rng.normal(0, 0.05,
+                                                  (hidden_dim, 1)).astype(np.float32)),
+            }
+            if k == 0:  # guest dense model keeps its bias (party_models.py:21)
+                p["dense_b"] = jnp.zeros((1,), jnp.float32)
+            self.params.append(p)
+        self.step, self._party_logit, opt = build_neural_vfl_step(lr, momentum, wd)
+        self.opt_state = opt.init(tuple(self.params))
+        self.loss_history: list[float] = []
+
+    def fit(self, party_xs: list[np.ndarray], y: np.ndarray,
+            epochs: int = 10, batch_size: int = 64, seed: int = 0):
+        n = len(y)
+        rng = np.random.RandomState(seed)
+        for _e in range(epochs):
+            order = rng.permutation(n)
+            for s in range(0, n - batch_size + 1, batch_size):
+                idx = order[s:s + batch_size]
+                xs = [jnp.asarray(x[idx]) for x in party_xs]
+                params, self.opt_state, loss = self.step(
+                    tuple(self.params), self.opt_state, xs, jnp.asarray(y[idx]))
+                self.params = list(params)
+                self.loss_history.append(float(loss))
+        return self
+
+    def predict_proba(self, party_xs: list[np.ndarray]) -> np.ndarray:
+        u = jnp.zeros((len(party_xs[0]),), jnp.float32)
+        for p, x in zip(self.params, party_xs):
+            u = u + self._party_logit(p, jnp.asarray(x))
+        return np.asarray(jax.nn.sigmoid(u))
+
+    def score(self, party_xs, y) -> float:
+        return float(np.mean((self.predict_proba(party_xs) > 0.5).astype(int) == y))

@@ -427,3 +427,31 @@ _register_tabular("purchase100", 100)
 _register_tabular("texas100", 100)
 _register_tabular("har", 6)
 _register_tabular("chmnist", 8)
+
+
+def load_vfl_parties(name: str, data_dir: str = "./data", seed: int = 0,
+                     three_party: bool = False):
+    """Vertical-FL party data (outside the 9-tuple contract — features are
+    split across parties, not samples across clients). name: "nus_wide"
+    (reference NUS_WIDE/nus_wide_dataset.py) or "lending_club"
+    (lending_club_loan/lending_club_dataset.py). Returns (parties_train,
+    y_train, parties_test, y_test); seeded surrogate when files are absent."""
+    from fedml_tpu.data import readers
+
+    if name not in ("nus_wide", "lending_club"):
+        raise ValueError(f"unknown VFL dataset {name!r}")
+    ref = None
+    try:
+        if name == "nus_wide":
+            ref = readers.read_nus_wide(data_dir, three_party=three_party)
+        else:
+            ref = readers.read_lending_club(data_dir)
+    except Exception as e:  # corrupt files -> surrogate, like every loader here
+        sources.log.warning("failed reading %s (%s)", name, e)
+    if ref is not None:
+        return ref
+    sources.log.warning("%s files not found under %s — using seeded VFL "
+                        "surrogate", name, data_dir)
+    dims = {"nus_wide": (634, 500, 500) if three_party else (634, 1000),
+            "lending_club": (18, 18)}[name]
+    return readers.synthetic_vfl_parties(dims, seed=seed)

@@ -350,6 +350,100 @@ def find_hetero_fix_map(data_dir: str, dataset: str) -> str | None:
 
 
 # ---------------------------------------------------------------------------
+# vertical-FL party datasets (NUS-WIDE / lending club)
+
+
+def read_nus_wide(data_dir: str, selected_labels=("sky", "clouds", "person",
+                                                  "water", "animal"),
+                  n_samples: int = -1, three_party: bool = False):
+    """NUS-WIDE two/three-party vertical split (reference
+    NUS_WIDE/nus_wide_dataset.py:23-71): party A = the 634 normalized
+    low-level image features (Low_Level_Features/<dtype>_Normalized_*.dat,
+    space-separated), party B = the 1k tag vector
+    (NUS_WID_Tags/<dtype>_Tags1k.dat, tab-separated); labels from
+    Groundtruth/TrainTestLabels/Labels_<label>_<dtype>.txt, keeping rows
+    with exactly one positive among the selected labels; y = 1 iff the
+    first selected label fires. Returns (parties_train, y_train,
+    parties_test, y_test) or None."""
+    import pandas as pd
+
+    if not os.path.isdir(os.path.join(data_dir, "Low_Level_Features")):
+        return None
+
+    def load(dtype):
+        dfs = []
+        for label in selected_labels:
+            f = os.path.join(data_dir, "Groundtruth", "TrainTestLabels",
+                             f"Labels_{label}_{dtype}.txt")
+            df = pd.read_csv(f, header=None)
+            df.columns = [label]
+            dfs.append(df)
+        labels = pd.concat(dfs, axis=1)
+        sel = labels[labels.sum(axis=1) == 1] if len(selected_labels) > 1 else labels
+        feat_dir = os.path.join(data_dir, "Low_Level_Features")
+        fdfs = [pd.read_csv(os.path.join(feat_dir, f), header=None, sep=" ")
+                    .dropna(axis=1)
+                for f in sorted(os.listdir(feat_dir))
+                if f.startswith(f"{dtype}_Normalized")]
+        xa = pd.concat(fdfs, axis=1).loc[sel.index].values.astype(np.float32)
+        tags = pd.read_csv(os.path.join(data_dir, "NUS_WID_Tags",
+                                        f"{dtype}_Tags1k.dat"),
+                           header=None, sep="\t").dropna(axis=1)
+        xb = tags.loc[sel.index].values.astype(np.float32)
+        y = (sel.values[:, 0] > 0).astype(np.int32)
+        if n_samples != -1:
+            xa, xb, y = xa[:n_samples], xb[:n_samples], y[:n_samples]
+        if three_party:
+            half = xb.shape[1] // 2
+            return [xa, xb[:, :half], xb[:, half:]], y
+        return [xa, xb], y
+
+    ptr, ytr = load("Train")
+    pte, yte = load("Test")
+    return ptr, ytr, pte, yte
+
+
+def read_lending_club(data_dir: str):
+    """Lending-club two-party vertical split (reference
+    lending_club_dataset.py:126-155): processed_loan.csv with normalized
+    feature columns + 'target'; party A = qualification + loan features,
+    party B = the remaining debt/repayment/account/behavior features,
+    80/20 train split. Returns (parties_train, y_train, parties_test,
+    y_test) or None."""
+    import pandas as pd
+
+    fp = os.path.join(data_dir, "processed_loan.csv")
+    if not os.path.exists(fp):
+        return None
+    df = pd.read_csv(fp, low_memory=False)
+    y = df["target"].values.astype(np.int32)
+    feat_cols = [c for c in df.columns if c != "target"]
+    half = len(feat_cols) // 2  # party A = first half of the feature groups
+    xa = df[feat_cols[:half]].values.astype(np.float32)
+    xb = df[feat_cols[half:]].values.astype(np.float32)
+    k = int(0.8 * len(y))
+    return [xa[:k], xb[:k]], y[:k], [xa[k:], xb[k:]], y[k:]
+
+
+def synthetic_vfl_parties(party_dims=(24, 40), n_train: int = 800,
+                          n_test: int = 200, seed: int = 0):
+    """Seeded surrogate vertical data: a shared latent drives all parties'
+    features and the label, so VFL training is learnable."""
+    rng = np.random.RandomState(seed)
+    z = rng.normal(size=(n_train + n_test, 8)).astype(np.float32)
+    w_y = rng.normal(size=8).astype(np.float32)
+    y = (z @ w_y + 0.3 * rng.normal(size=len(z)) > 0).astype(np.int32)
+    parties = []
+    for d in party_dims:
+        proj = rng.normal(size=(8, d)).astype(np.float32)
+        x = z @ proj + 0.3 * rng.normal(size=(len(z), d)).astype(np.float32)
+        parties.append(x.astype(np.float32))
+    tr = [x[:n_train] for x in parties]
+    te = [x[n_train:] for x in parties]
+    return tr, y[:n_train], te, y[n_train:]
+
+
+# ---------------------------------------------------------------------------
 # Pascal VOC segmentation
 
 
