@@ -81,6 +81,7 @@ def main():
         return float(jnp.asarray(leaf).ravel()[0])
 
     scan_rounds = int(os.environ.get("BENCH_SCAN_ROUNDS", 20))
+    reps = max(1, int(os.environ.get("BENCH_REPS", 3)))  # best-of-N vs tunnel jitter
     if scan_rounds > 1 and n_chips == 1:
         # dispatch-amortized fast path: R rounds per jit call (in-graph sampling)
         from fedml_tpu.algorithms.engine import build_multi_round_fn
@@ -89,21 +90,29 @@ def main():
         gv, state, _ = multi(gv, state, x, y, counts, key)  # warmup/compile
         readback(gv)
         calls = max(1, timed_rounds // scan_rounds)
-        t0 = time.perf_counter()
-        for r in range(calls):
-            gv, state, _ = multi(gv, state, x, y, counts, jax.random.fold_in(key, r))
-        readback(gv)
-        dt = time.perf_counter() - t0
+        best = float("inf")
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            for r in range(calls):
+                gv, state, _ = multi(gv, state, x, y, counts,
+                                     jax.random.fold_in(key, rep * calls + r))
+            readback(gv)
+            best = min(best, time.perf_counter() - t0)
+        dt = best
         timed_rounds = calls * scan_rounds
     else:
         # warmup (compile)
         gv, state, _ = round_fn(gv, state, x, y, counts, key)
         readback(gv)
-        t0 = time.perf_counter()
-        for r in range(timed_rounds):
-            gv, state, _ = round_fn(gv, state, x, y, counts, jax.random.fold_in(key, r))
-        readback(gv)
-        dt = time.perf_counter() - t0
+        best = float("inf")
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            for r in range(timed_rounds):
+                gv, state, _ = round_fn(gv, state, x, y, counts,
+                                        jax.random.fold_in(key, rep * timed_rounds + r))
+            readback(gv)
+            best = min(best, time.perf_counter() - t0)
+        dt = best
 
     rounds_per_sec = timed_rounds / dt
     samples_per_round = clients_per_round * n_per_client * epochs

@@ -216,11 +216,13 @@ def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -
             rng = jax.random.fold_in(base_rng, round_idx)
             if k < c_total:
                 idx = jax.random.permutation(jax.random.fold_in(rng, 0x5A11), c_total)[:k]
+                xs = jnp.take(x, idx, axis=0)
+                ys = jnp.take(y, idx, axis=0)
+                cs = jnp.take(counts, idx, axis=0)
             else:
-                idx = jnp.arange(c_total)
-            xs = jnp.take(x, idx, axis=0)
-            ys = jnp.take(y, idx, axis=0)
-            cs = jnp.take(counts, idx, axis=0)
+                # full participation: the identity gather would still move the
+                # whole federation through HBM every round — skip it
+                xs, ys, cs = x, y, counts
             crngs = jax.random.split(rng, k)
             result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
                 gv, xs, ys, cs, crngs

@@ -24,6 +24,18 @@ import numpy as np
 import optax
 
 
+
+def _minibatch_indices(n: int, epochs: int, batch_size: int, seed: int):
+    """Shared epoch/minibatch sweep for both VFL APIs: seeded permutation per
+    epoch, full batches only (the tail < batch_size is dropped, matching the
+    reference's range(0, n - bs + 1, bs) loop)."""
+    rng = np.random.RandomState(seed)
+    for _e in range(epochs):
+        order = rng.permutation(n)
+        for s in range(0, n - batch_size + 1, batch_size):
+            yield order[s:s + batch_size]
+
+
 def build_vfl_step(cfg_lr: float) -> Callable:
     """Returns step(params_list, opt_states, xs, y) -> (params, opts, loss).
 
@@ -81,17 +93,12 @@ class VerticalFederatedLearningAPI:
 
     def fit(self, X: np.ndarray, y: np.ndarray, epochs: int = 10, batch_size: int = 64,
             seed: int = 0):
-        n = len(y)
-        rng = np.random.RandomState(seed)
-        for e in range(epochs):
-            order = rng.permutation(n)
-            for s in range(0, n - batch_size + 1, batch_size):
-                idx = order[s:s + batch_size]
-                xs = self._slice(X[idx])
-                self.params, self.opt_states, loss = self.step(
-                    self.params, self.opt_states, xs, jnp.asarray(y[idx])
-                )
-                self.loss_history.append(float(loss))
+        for idx in _minibatch_indices(len(y), epochs, batch_size, seed):
+            xs = self._slice(X[idx])
+            self.params, self.opt_states, loss = self.step(
+                self.params, self.opt_states, xs, jnp.asarray(y[idx])
+            )
+            self.loss_history.append(float(loss))
         return self
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
@@ -172,17 +179,12 @@ class NeuralVFLAPI:
 
     def fit(self, party_xs: list[np.ndarray], y: np.ndarray,
             epochs: int = 10, batch_size: int = 64, seed: int = 0):
-        n = len(y)
-        rng = np.random.RandomState(seed)
-        for _e in range(epochs):
-            order = rng.permutation(n)
-            for s in range(0, n - batch_size + 1, batch_size):
-                idx = order[s:s + batch_size]
-                xs = [jnp.asarray(x[idx]) for x in party_xs]
-                params, self.opt_state, loss = self.step(
-                    tuple(self.params), self.opt_state, xs, jnp.asarray(y[idx]))
-                self.params = list(params)
-                self.loss_history.append(float(loss))
+        for idx in _minibatch_indices(len(y), epochs, batch_size, seed):
+            xs = [jnp.asarray(x[idx]) for x in party_xs]
+            params, self.opt_state, loss = self.step(
+                tuple(self.params), self.opt_state, xs, jnp.asarray(y[idx]))
+            self.params = list(params)
+            self.loss_history.append(float(loss))
         return self
 
     def predict_proba(self, party_xs: list[np.ndarray]) -> np.ndarray:

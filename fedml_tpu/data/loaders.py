@@ -441,17 +441,21 @@ def load_vfl_parties(name: str, data_dir: str = "./data", seed: int = 0,
     if name not in ("nus_wide", "lending_club"):
         raise ValueError(f"unknown VFL dataset {name!r}")
     ref = None
+    failed = False
     try:
         if name == "nus_wide":
             ref = readers.read_nus_wide(data_dir, three_party=three_party)
         else:
-            ref = readers.read_lending_club(data_dir)
+            ref = readers.read_lending_club(data_dir, seed=seed)
     except Exception as e:  # corrupt files -> surrogate, like every loader here
-        sources.log.warning("failed reading %s (%s)", name, e)
+        sources.log.warning("failed reading %s (%s) — using seeded VFL "
+                            "surrogate", name, e)
+        failed = True
     if ref is not None:
         return ref
-    sources.log.warning("%s files not found under %s — using seeded VFL "
-                        "surrogate", name, data_dir)
+    if not failed:
+        sources.log.warning("%s files not found under %s — using seeded VFL "
+                            "surrogate", name, data_dir)
     dims = {"nus_wide": (634, 500, 500) if three_party else (634, 1000),
             "lending_club": (18, 18)}[name]
     return readers.synthetic_vfl_parties(dims, seed=seed)

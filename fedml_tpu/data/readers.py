@@ -403,13 +403,15 @@ def read_nus_wide(data_dir: str, selected_labels=("sky", "clouds", "person",
     return ptr, ytr, pte, yte
 
 
-def read_lending_club(data_dir: str):
+def read_lending_club(data_dir: str, seed: int = 0):
     """Lending-club two-party vertical split (reference
     lending_club_dataset.py:126-155): processed_loan.csv with normalized
     feature columns + 'target'; party A = qualification + loan features,
     party B = the remaining debt/repayment/account/behavior features,
-    80/20 train split. Returns (parties_train, y_train, parties_test,
-    y_test) or None."""
+    seeded-shuffled 80/20 train split (preprocessed dumps are often
+    target- or date-ordered; an unshuffled head/tail cut would give a
+    distribution-shifted test set). Returns (parties_train, y_train,
+    parties_test, y_test) or None."""
     import pandas as pd
 
     fp = os.path.join(data_dir, "processed_loan.csv")
@@ -421,6 +423,8 @@ def read_lending_club(data_dir: str):
     half = len(feat_cols) // 2  # party A = first half of the feature groups
     xa = df[feat_cols[:half]].values.astype(np.float32)
     xb = df[feat_cols[half:]].values.astype(np.float32)
+    perm = np.random.RandomState(seed).permutation(len(y))
+    xa, xb, y = xa[perm], xb[perm], y[perm]
     k = int(0.8 * len(y))
     return [xa[:k], xb[:k]], y[:k], [xa[k:], xb[k:]], y[k:]
 
