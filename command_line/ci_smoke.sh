@@ -72,10 +72,12 @@ python -m fedml_tpu.experiments.main_fednova $COMMON --dataset mnist --model lr 
   --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4
 assert_summary "Test/Acc" 0.0 1.0
 
-echo "== fedavg_robust"
+echo "== fedavg_robust (poisoned attacker + backdoor eval)"
 python -m fedml_tpu.experiments.main_fedavg_robust $COMMON --dataset mnist --model lr \
-  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4 \
+  --attacker_num 1 --poison_frac 0.3
 assert_summary "Test/Acc" 0.0 1.0
+assert_summary "Backdoor/SuccessRate" 0.0 1.0
 
 echo "== hierarchical"
 python -m fedml_tpu.experiments.main_hierarchical $COMMON --dataset mnist --model lr \

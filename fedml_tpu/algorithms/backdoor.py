@@ -19,9 +19,20 @@ import jax.numpy as jnp
 
 def apply_trigger(x: np.ndarray, size: int = 3, value: float | None = None) -> np.ndarray:
     """Stamp a square trigger in the bottom-right corner of [n, h, w, c]
-    images (value defaults to the per-array max = saturated pixels)."""
+    images (value defaults to the per-array max = saturated pixels).
+    Flattened square images [n, d] with d = s*s are reshaped, stamped and
+    re-flattened so the flat-input models (e.g. MNIST LR) work too."""
     x = np.array(x, copy=True)
     v = float(x.max()) if value is None else value
+    if x.ndim == 2:
+        side = int(round(x.shape[1] ** 0.5))
+        if side * side != x.shape[1]:
+            raise ValueError(
+                f"cannot stamp a 2-D trigger on flat features of dim "
+                f"{x.shape[1]} (not a square image)")
+        img = x.reshape(-1, side, side)
+        img[:, -size:, -size:] = v
+        return img.reshape(x.shape)
     x[..., -size:, -size:, :] = v
     return x
 
@@ -34,9 +45,11 @@ def poison_client_data(x: np.ndarray, y: np.ndarray, count: int,
     (trigger + target label). Returns new (x, y)."""
     rng = rng or np.random.RandomState(0)
     n_poison = int(count * poison_frac)
-    idx = rng.choice(count, n_poison, replace=False)
     x = np.array(x, copy=True)
     y = np.array(y, copy=True)
+    if n_poison == 0:  # tiny client x small frac rounds to nothing to poison
+        return x, y
+    idx = rng.choice(count, n_poison, replace=False)
     x[idx] = apply_trigger(x[idx], trigger_size)
     y[idx] = target_label
     return x, y

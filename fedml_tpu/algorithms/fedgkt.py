@@ -116,7 +116,8 @@ class FedGKTAPI:
                  temperature: float = 3.0, server_epochs: int = 1,
                  use_epoch_schedule: bool = False,
                  distill_on_server: bool = True,
-                 train_on_client: bool = True):
+                 train_on_client: bool = True,
+                 pretrained_server_ckpt: str | None = None):
         self.dataset = dataset
         self.cfg = cfg
         self.alpha = alpha
@@ -140,6 +141,17 @@ class FedGKTAPI:
         self.server_vars = server_module.init(
             {"params": jax.random.fold_in(rng, 1)}, feat, train=False
         )
+        if pretrained_server_ckpt:
+            # reference resnet56_pretrained(pretrained=True, path=...) — the
+            # server model warm-starts from a saved checkpoint
+            from fedml_tpu.utils.checkpoint import restore_checkpoint
+
+            out = restore_checkpoint(pretrained_server_ckpt, self.server_vars)
+            if out is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {pretrained_server_ckpt!r} for the "
+                    "pretrained GKT server")
+            self.server_vars = out[0]
         self.c_opt = _make_gkt_optimizer(cfg)
         self.s_opt = _make_gkt_optimizer(cfg)
         self.client_opt_states = jax.vmap(

@@ -459,3 +459,38 @@ def load_vfl_parties(name: str, data_dir: str = "./data", seed: int = 0,
     dims = {"nus_wide": (634, 500, 500) if three_party else (634, 1000),
             "lending_club": (18, 18)}[name]
     return readers.synthetic_vfl_parties(dims, seed=seed)
+
+
+@register_loader("raw_mnist")
+def load_raw_mnist(data_dir="./data", client_num_in_total=1000, seed=0, **_):
+    """LEAF-json MNIST with natural per-device clients (reference
+    raw_MNIST/data_loader.py:80-124 load_partition_data_mnist_1000fix —
+    the mobile-deployment data format). Reads <data_dir>/{train,test}/*.json;
+    surrogate: 1000 small natural-split clients."""
+    from fedml_tpu.data import readers
+
+    ref = None
+    failed = False
+    try:
+        ref = readers.read_leaf_json_clients(data_dir)
+    except Exception as e:
+        sources.log.warning("failed reading raw_mnist LEAF json (%s) — using "
+                            "seeded surrogate", e)
+        failed = True
+    if ref is not None:
+        xtr, ytr, xte, yte = ref
+    else:
+        if not failed:
+            sources.log.warning("raw_mnist LEAF json not found under %s — "
+                                "using seeded surrogate", data_dir)
+        rng = np.random.RandomState(seed)
+        protos = rng.normal(0.0, 1.0, (10, 28, 28, 1)).astype(np.float32)
+        xtr, ytr, xte, yte = [], [], [], []
+        for _c in range(client_num_in_total):
+            n_i = int(np.clip(rng.lognormal(3.2, 0.4), 8, 96))
+            t_i = max(1, n_i // 6)
+            y_i = rng.randint(0, 10, n_i + t_i).astype(np.int32)
+            x_i = protos[y_i] * 0.6 + rng.normal(0, 0.35, (n_i + t_i, 28, 28, 1)).astype(np.float32)
+            xtr.append(x_i[:n_i]); ytr.append(y_i[:n_i])
+            xte.append(x_i[n_i:]); yte.append(y_i[n_i:])
+    return _from_client_lists("raw_mnist", xtr, ytr, xte, yte, 10)

@@ -350,6 +350,45 @@ def find_hetero_fix_map(data_dir: str, dataset: str) -> str | None:
 
 
 # ---------------------------------------------------------------------------
+# raw_MNIST (LEAF json)
+
+
+def read_leaf_json_clients(data_dir: str, x_shape=(28, 28, 1)):
+    """LEAF-json per-client data: <root>/{train,test}/*.json with 'users' and
+    'user_data' {uid: {x: [[784 floats]], y: [ints]}} (reference
+    raw_MNIST/data_loader.py:9-50). Returns (xtr_list, ytr_list, xte_list,
+    yte_list) aligned by sorted user id, or None."""
+    import json
+
+    tr_dir = os.path.join(data_dir, "train")
+    te_dir = os.path.join(data_dir, "test")
+    if not (os.path.isdir(tr_dir) and os.path.isdir(te_dir)):
+        return None
+
+    def read(d):
+        users, data = [], {}
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".json"):
+                with open(os.path.join(d, fn)) as f:
+                    j = json.load(f)
+                users += j["users"]
+                data.update(j["user_data"])
+        return users, data
+
+    users, tr = read(tr_dir)
+    _, te = read(te_dir)
+    if not users:
+        return None
+    empty = {"x": [], "y": []}
+    xtr, ytr, xte, yte = [], [], [], []
+    for u in sorted(set(users)):
+        for d, xs, ys in ((tr.get(u, empty), xtr, ytr), (te.get(u, empty), xte, yte)):
+            xs.append(np.asarray(d["x"], np.float32).reshape((-1,) + x_shape))
+            ys.append(np.asarray(d["y"], np.int32))
+    return xtr, ytr, xte, yte
+
+
+# ---------------------------------------------------------------------------
 # vertical-FL party datasets (NUS-WIDE / lending club)
 
 

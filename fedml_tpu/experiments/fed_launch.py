@@ -1,0 +1,104 @@
+"""YAML-driven experiment launcher (reference fedml_experiments/distributed/
+fed_launch/: run_fedavg.sh + main.py dispatch over a hostfile + gpu_util
+YAML). The TPU-native launch has no mpirun/hostfiles — one process drives
+the device mesh — so the YAML describes the *experiment*: algorithm,
+model, dataset, hyperparameters and mesh shape; multi-host deployments add
+a `multihost:` block (coordinator address + process grid) consumed by
+`fedml_tpu.parallel.multihost.init_multihost`.
+
+Config example (see also configs/ in this directory):
+
+    algorithm: fedavg            # any main in fedml_tpu.experiments
+    args:
+      dataset: femnist
+      model: cnn
+      client_num_in_total: 3400
+      client_num_per_round: 10
+      comm_round: 100
+      batch_size: 20
+      lr: 0.1
+      backend: shard_map
+      mesh_shape: [8]
+    # multihost:                 # optional cross-silo deployment
+    #   coordinator: "10.0.0.1:1234"
+    #   num_processes: 4
+    #   process_id: 0            # or taken from $FEDML_PROCESS_ID
+
+Usage:
+  python -m fedml_tpu.experiments.fed_launch --config exp.yaml
+  python -m fedml_tpu.experiments.fed_launch --config exp.yaml --override comm_round=2
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+
+ALGORITHMS = {
+    # algorithm name -> experiments module with a main(argv) entry
+    name: f"fedml_tpu.experiments.main_{name}"
+    for name in ("fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
+                 "decentralized", "fednas", "base", "fedgkt", "split_nn", "vfl",
+                 "turboaggregate", "fedseg", "privacy")
+}
+
+
+def _load_yaml(path: str) -> dict:
+    try:
+        import yaml
+
+        with open(path) as f:
+            return yaml.safe_load(f)
+    except ImportError:
+        # yaml is optional in this image — accept the JSON subset
+        import json
+
+        with open(path) as f:
+            return json.load(f)
+
+
+def config_to_argv(args_map: dict) -> list[str]:
+    argv: list[str] = []
+    for k, v in args_map.items():
+        if isinstance(v, bool):
+            if v:
+                argv.append(f"--{k}")  # bare store_true flag; False -> omit
+        elif isinstance(v, (list, tuple)):
+            argv += [f"--{k}"] + [str(x) for x in v]
+        else:
+            argv += [f"--{k}", str(v)]
+    return argv
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, required=True)
+    parser.add_argument("--override", type=str, nargs="*", default=[],
+                        help="key=value overrides applied on top of the YAML")
+    args = parser.parse_args(argv)
+    cfg = _load_yaml(args.config)
+    algo = cfg.get("algorithm", "fedavg")
+    if algo not in ALGORITHMS:
+        raise SystemExit(f"unknown algorithm {algo!r}; one of {sorted(ALGORITHMS)}")
+    exp_args = dict(cfg.get("args", {}))
+    for ov in args.override:
+        k, _, v = ov.partition("=")
+        exp_args[k] = v
+
+    mh = cfg.get("multihost")
+    if mh:
+        from fedml_tpu.parallel.multihost import init_multihost
+
+        pid = mh.get("process_id")
+        if pid is None:
+            pid = int(os.environ.get("FEDML_PROCESS_ID", "0"))
+        info = init_multihost(mh["coordinator"], int(mh["num_processes"]), int(pid))
+        print(f"multihost topology: {info}")
+
+    module = importlib.import_module(ALGORITHMS[algo])
+    return module.main(config_to_argv(exp_args))
+
+
+if __name__ == "__main__":
+    main()

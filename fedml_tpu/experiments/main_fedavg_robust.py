@@ -1,18 +1,89 @@
 """Robust FedAvg experiment main (reference fedml_experiments/distributed/
-fedavg_robust/ — norm-clipping + weak-DP defense aggregation)."""
+fedavg_robust/ + FedAvgRobustAggregator.py:14-112): norm-clipping + weak-DP
+defense aggregation under an active backdoor attacker, with poisoned-task
+evaluation alongside the main task.
+
+Attackers (the first `--attacker_num` clients) poison `--poison_frac` of
+their local samples: with the reference's edge-case pickles present under
+--data_dir (southwest airplanes labeled as truck) those images are used;
+otherwise the pixel-trigger substitute. After training the final model is
+scored on main-task accuracy AND backdoor success rate, written to
+wandb-summary.json.
+"""
 
 from __future__ import annotations
 
-from fedml_tpu.experiments.main_fedavg import main as fedavg_main
+import argparse
+
+import numpy as np
+
+from fedml_tpu.algorithms.backdoor import (
+    backdoor_metrics,
+    load_edge_case_sets,
+    poison_client_data,
+)
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.utils.logging import MetricsLogger
 
 
-def _extra(parser):
+def _extra(parser: argparse.ArgumentParser):
     parser.add_argument("--norm_bound", type=float, default=5.0)
     parser.add_argument("--stddev", type=float, default=0.025)
+    parser.add_argument("--attacker_num", type=int, default=1)
+    parser.add_argument("--poison_frac", type=float, default=0.5)
+    parser.add_argument("--target_label", type=int, default=9)
+    parser.add_argument("--trigger_size", type=int, default=3)
 
 
 def main(argv=None):
-    return fedavg_main(argv, aggregator_name="robust", extra_args=_extra)
+    parser = add_args(argparse.ArgumentParser())
+    _extra(parser)
+    args = parser.parse_args(argv)
+    cfg, ds, trainer = setup_run(args)
+    logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
+
+    # ---- poison the attackers' packed rows (reference load_poisoned_dataset)
+    edge = None
+    img_shape = ds.train.x.shape[2:]
+    if img_shape == (32, 32, 3):  # edge-case sets are CIFAR-shaped
+        edge = load_edge_case_sets(args.data_dir)
+    rng = np.random.RandomState(cfg.seed)
+    for k in range(min(args.attacker_num, ds.train.num_clients)):
+        count = int(ds.train.counts[k])
+        if edge is not None:
+            x_poison, _, target = edge
+            n_p = min(int(count * args.poison_frac), len(x_poison))
+            idx = rng.choice(count, n_p, replace=False)
+            ds.train.x[k][idx] = x_poison[:n_p]
+            ds.train.y[k][idx] = target
+        else:
+            x_new, y_new = poison_client_data(
+                ds.train.x[k], ds.train.y[k], count, args.target_label,
+                args.poison_frac, args.trigger_size, rng)
+            ds.train.x[k] = x_new
+            ds.train.y[k] = y_new
+
+    api = FedAvgAPI(ds, cfg, trainer, aggregator_name="robust")
+    history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger)
+
+    # ---- poisoned-task eval (reference test(..., mode="targetted-task"))
+    import jax.numpy as jnp
+
+    def predict(x):
+        logits, _ = trainer.apply(api.global_variables, x, train=False)
+        return logits
+
+    xte, yte = ds.test_global
+    n = min(len(yte), 2048)
+    bm = backdoor_metrics(
+        predict, jnp.asarray(xte[:n]), np.asarray(yte[:n]),
+        target_label=(edge[2] if edge is not None else args.target_label),
+        trigger_size=args.trigger_size,
+        x_edge_case=(edge[1] if edge is not None else None))
+    logger.log(bm, step=cfg.comm_round)
+    logger.finish()
+    return history
 
 
 if __name__ == "__main__":

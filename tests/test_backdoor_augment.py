@@ -80,3 +80,24 @@ def test_augmented_trainer_end_to_end():
     api = FedAvgAPI(ds, cfg, trainer)
     hist = api.train()
     assert np.isfinite(hist[-1]["Test/Loss"])
+
+
+def test_main_fedavg_robust_backdoor_eval(tmp_path):
+    """Robust main poisons attacker clients and reports MainTask/Acc +
+    Backdoor/SuccessRate in wandb-summary.json (reference poisoned-task
+    eval, FedAvgRobustAggregator.py:14-112)."""
+    import json
+
+    from fedml_tpu.experiments.main_fedavg_robust import main
+
+    main([
+        "--dataset", "mnist", "--model", "lr", "--partition_method", "homo",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--comm_round", "2", "--epochs", "1", "--batch_size", "32",
+        "--lr", "0.1", "--attacker_num", "1", "--poison_frac", "0.5",
+        "--target_label", "3", "--run_dir", str(tmp_path / "run"),
+    ])
+    summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())
+    assert "MainTask/Acc" in summary and "Backdoor/SuccessRate" in summary
+    assert summary["MainTask/Acc"] > 0.5
+    assert 0.0 <= summary["Backdoor/SuccessRate"] <= 1.0

@@ -175,6 +175,7 @@ def test_pgd_attack_reduces_accuracy():
 # ------------------------------------------------- multi-model / blockensemble
 
 
+@pytest.mark.slow
 def test_joint_local_update_trains_two_models(mnist8_img):
     """TwoModelTrainer semantics: both paths improve on the client's data and
     the feature-matching term pulls block features together."""

@@ -336,3 +336,44 @@ def test_hierarchical_ragged_groups():
     assert hist[-1]["Test/Acc"] > 0.8
     # padded rows are zero-count: total samples == real federation size
     assert float(api._counts.sum()) == ds.train.counts.sum()
+
+
+def test_fedgkt_pretrained_server_warmstart(tmp_path):
+    """Reference resnet56_pretrained(pretrained=True, path=...): the GKT
+    server model warm-starts from a saved checkpoint."""
+    import jax
+
+    from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.packing import PackedClients
+    from fedml_tpu.data.registry import FederatedDataset
+    from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
+    from fedml_tpu.utils.checkpoint import save_checkpoint
+
+    rng = np.random.RandomState(0)
+    C, n = 2, 8
+    x = rng.rand(C, n, 16, 16, 3).astype(np.float32)
+    y = rng.randint(0, 4, (C, n)).astype(np.int32)
+    ds = FederatedDataset(name="tiny", train=PackedClients(x, y, np.full(C, n, np.int32)),
+                          test=None,
+                          train_global=(x.reshape(-1, 16, 16, 3), y.reshape(-1)),
+                          test_global=(x.reshape(-1, 16, 16, 3), y.reshape(-1)),
+                          class_num=4)
+    cfg = FedConfig(comm_round=1, epochs=1, batch_size=4, lr=0.05,
+                    client_num_in_total=C, client_num_per_round=C)
+    client = GKTClientResNet(output_dim=4)
+    server = GKTServerResNet(output_dim=4, layers=(1, 1, 1))
+    base = FedGKTAPI(ds, cfg, client, server)
+    # perturb + save the server vars as a "pretrained" checkpoint
+    pre = jax.tree.map(lambda l: l + 0.123, base.server_vars)
+    save_checkpoint(str(tmp_path), 0, {"tree": pre})
+    warm = FedGKTAPI(ds, cfg, client, server,
+                     pretrained_server_ckpt=str(tmp_path))
+    got = jax.tree.leaves(warm.server_vars)[0]
+    want = jax.tree.leaves(pre)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError):
+        FedGKTAPI(ds, cfg, client, server,
+                  pretrained_server_ckpt=str(tmp_path / "missing"))
