@@ -12,7 +12,12 @@ if not os.environ.get("FEDML_TPU_TESTS_ON_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+        flags += " --xla_force_host_platform_device_count=8"
+    if "xla_backend_optimization_level" not in flags:
+        # the suite is compile-bound on CPU and test workloads are tiny, so
+        # trading codegen quality for compile time roughly halves wall-clock
+        flags += " --xla_backend_optimization_level=0"
+    os.environ["XLA_FLAGS"] = flags
 
     # this environment's sitecustomize pre-imports jax to register the TPU
     # plugin; the env var alone is then too late, but the backend is not yet
@@ -20,6 +25,14 @@ if not os.environ.get("FEDML_TPU_TESTS_ON_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    # persistent XLA compilation cache: the suite is compile-dominated on CPU,
+    # so warm re-runs drop to a fraction of the cold time (cache lives in the
+    # repo-local .jax_cache, gitignored)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -51,6 +51,7 @@ def test_main_base_cli():
     assert out == [0.0 + 1 + 2 + 3, 1.0 + 2 + 3 + 4]
 
 
+@pytest.mark.slow
 def test_main_split_nn_cli(tmp_path):
     from fedml_tpu.experiments.main_split_nn import main
 

@@ -14,7 +14,9 @@ from fedml_tpu.models.darts import (
 
 
 def test_darts_network_forward_shapes():
-    net = DARTSNetwork(output_dim=10, channels=4, layers=4)
+    # layers=3 places reductions at cells 1 and 2, so BOTH normal and
+    # reduction cells (and both alpha tables) are exercised
+    net = DARTSNetwork(output_dim=10, channels=4, layers=3)
     rng = jax.random.PRNGKey(0)
     an, ar = init_alphas(rng)
     assert an.shape == (14, len(PRIMITIVES))

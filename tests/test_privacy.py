@@ -26,7 +26,10 @@ def mnist8_img():
 
 def test_adaptive_cnn_variants_forward():
     x = jnp.zeros((2, 28, 28, 1))
-    for spec in build_hetero_archs(6):
+    specs = build_hetero_archs(4)
+    # 3 variants keep CI cheap but must include b=3's (48, 48) conv1 — the
+    # only spec whose internal-conv loop stacks more than one layer
+    for spec in (specs[0], specs[1], specs[3]):
         m = AdaptiveCNN(output_dim=10, arch=spec)
         v = m.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x)
         out = m.apply(v, x, train=False)
@@ -214,6 +217,7 @@ def test_joint_local_update_trains_two_models(mnist8_img):
     assert feat_dist(reg_paths) < feat_dist(new_paths)
 
 
+@pytest.mark.slow
 def test_blockensemble_round_updates_only_drawn_blocks(mnist8_img):
     """Reference average_updated_branch_params: a (branch, block) pair not
     drawn this round keeps its previous params; drawn ones change."""
