@@ -1,0 +1,60 @@
+"""Transformer LM for federated next-word prediction — the long-context
+model family the LSTM zoo (reference rnn.py) caps at 20-80 token windows.
+
+Uses the pallas flash-attention kernel (fedml_tpu/ops/attention.py) as the
+hot op: O(T) memory in the forward, so client windows can grow far past the
+reference's limits; across chips the same blocks compose with
+`fedml_tpu.parallel.sequence.ring_attention` (sequence sharded over a mesh
+axis). Pre-norm blocks, learned positional embeddings, per-position logits
+(NWPTrainer-compatible, like RNN_StackOverFlow)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.ops.attention import flash_attention
+
+
+class _Block(nn.Module):
+    d_model: int
+    heads: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t, dm = x.shape
+        hd = dm // self.heads
+        h = nn.LayerNorm(name="ln1")(x)
+        qkv = nn.Dense(3 * dm, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, hd),
+                            3, axis=2)  # each [B, T, H, hd]
+        # flash kernel wants block-divisible T; block = min(128, T) and T a
+        # multiple of it — guaranteed for T <= 128 or T % 128 == 0
+        blk = t if t < 128 else 128
+        attn = flash_attention(q, k, v, True, blk, blk)
+        attn = attn.reshape(b, t, dm)
+        x = x + nn.Dense(dm, use_bias=False, name="proj")(attn)
+        h = nn.LayerNorm(name="ln2")(x)
+        h = nn.gelu(nn.Dense(self.mlp_ratio * dm, name="mlp_up")(h))
+        return x + nn.Dense(dm, name="mlp_down")(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 10004
+    d_model: int = 128
+    heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_emb")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, name="pos_emb")(
+            jnp.arange(t)[None, :])
+        x = x + pos
+        for i in range(self.num_layers):
+            x = _Block(self.d_model, self.heads, name=f"block{i}")(x, train)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

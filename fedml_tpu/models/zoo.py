@@ -103,6 +103,19 @@ def _fcn(output_dim, **kw):
     return SimpleFCN(output_dim=output_dim, width=kw.get("width", 16))
 
 
+@register_model("transformer_nwp")
+def _transformer_nwp(output_dim, **kw):
+    # long-context NWP model (per-position logits like rnn_stackoverflow);
+    # flash-attention core, ring-attention-ready across a mesh
+    from fedml_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab_size=kw.get("vocab_size", output_dim),
+                         d_model=kw.get("d_model", 128),
+                         heads=kw.get("heads", 4),
+                         num_layers=kw.get("num_layers", 2),
+                         max_len=kw.get("max_len", 512))
+
+
 @register_model("mobilenet_v3")
 def _mobilenet_v3(output_dim, **kw):
     # reference main_fedavg.py "mobilenet_v3" -> MobileNetV3(model_mode=...)

@@ -1,0 +1,6 @@
+"""Custom TPU ops: pallas kernels for the hot paths + jnp references."""
+
+from fedml_tpu.ops.attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+)

@@ -1,0 +1,145 @@
+"""Attention ops: a pallas TPU flash-attention forward kernel + jnp reference.
+
+The reference framework has no attention models at all (SURVEY §2.9:
+longest sequence = 80-char Shakespeare windows), but long-context support is
+first-class here: this kernel is the single-chip building block, and
+fedml_tpu.parallel.sequence composes it across chips (ring attention over
+ICI / Ulysses all-to-all head sharding).
+
+Design (flash-attention-1 style, /opt/skills/guides/pallas_guide.md):
+- grid = (batch*heads, q_blocks); each program streams K/V blocks through
+  VMEM, keeping running max M, denominator L and numerator accumulator O in
+  f32 scratch — the online-softmax recurrence, so the full [T, T] score
+  matrix never materializes.
+- Q/K/V blocks are MXU-shaped (block 128 on sequence, full head dim lanes).
+- training: `flash_attention` is a jax.custom_vjp whose backward recomputes
+  through the jnp reference (standard recompute strategy — the memory win
+  in the forward is what long-context needs; XLA differentiates the
+  reference efficiently).
+- off-TPU (tests, CPU CI) the kernel runs in pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Plain-jnp scaled dot-product attention. q/k/v: [B, T, H, D]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, t_kv,
+                      q_block, scale, precision):
+    """One (batch*head, q_block) program: stream K/V blocks, online softmax."""
+    from jax.experimental import pallas as pl
+
+    qb = q_ref[:].astype(jnp.float32) * scale  # [block_q, D]
+    block_q = qb.shape[0]
+    qi = pl.program_id(1)
+
+    def body(i, carry):
+        o, m, l = carry
+        kb = k_ref[pl.dslice(i * block_k, block_k), :]
+        vb = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = jax.lax.dot(qb, kb.astype(jnp.float32).T, precision=precision)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(-inf - -inf) guards: rows with no valid keys keep m=-inf
+        alpha = jnp.exp(jnp.where(m == -jnp.inf, 0.0, m - m_new))
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[:, None] + jax.lax.dot(p, vb.astype(jnp.float32),
+                                             precision=precision)
+        return o, m_new, l
+
+    n_kb = t_kv // block_k
+    if causal:
+        # blocks strictly after this q block's last row contribute nothing
+        n_live = jnp.minimum(n_kb, ((qi + 1) * q_block - 1) // block_k + 1)
+    else:
+        n_live = n_kb
+    o = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_live, body, (o, m, l))
+    o_ref[:] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    from jax.experimental import pallas as pl
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(f"sequence lengths ({tq}, {tk}) must be multiples of "
+                         f"the block sizes ({block_q}, {block_k})")
+    # [B, T, H, D] -> [B*H, T, D] program-major layout
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    # f32 inputs get true-f32 MXU passes (measured: the kernel then matches
+    # a HIGHEST-precision dense reference to ~1e-6 while XLA's default-
+    # precision einsum drifts ~1e-2); bf16 inputs keep native MXU speed
+    precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    kernel = functools.partial(
+        _flash_fwd_kernel, causal=causal, block_k=block_k, t_kv=tk,
+        q_block=block_q, scale=1.0 / np.sqrt(d), precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Flash attention, pallas forward. q/k/v: [B, T, H, D].
+
+    `interpret=None` auto-selects: compiled on TPU, interpret mode elsewhere
+    (the CPU CI path). Backward recomputes through attention_reference."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -14,8 +14,9 @@ from fedml_tpu.models.darts import (
 
 
 def test_darts_network_forward_shapes():
-    # layers=3 places reductions at cells 1 and 2, so BOTH normal and
-    # reduction cells (and both alpha tables) are exercised
+    # layers=3 keeps compile cheap while still exercising BOTH cell types:
+    # reductions land at cells (layers//3, 2*layers//3) = (1, 2), cell 0 is
+    # a normal cell (same placement as layers=4, one normal cell fewer)
     net = DARTSNetwork(output_dim=10, channels=4, layers=3)
     rng = jax.random.PRNGKey(0)
     an, ar = init_alphas(rng)
