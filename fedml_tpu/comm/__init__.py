@@ -1,0 +1,4 @@
+"""Mobile/IoT control-plane transport (the reference's MQTT path)."""
+
+from fedml_tpu.comm.message import Message  # noqa: F401
+from fedml_tpu.comm.mqtt import MiniBroker, MqttClient, MqttCommManager  # noqa: F401

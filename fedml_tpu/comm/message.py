@@ -1,0 +1,81 @@
+"""Typed message envelope for the mobile transport.
+
+The core TPU path has no message envelopes — rounds are jitted functions
+(algorithms/engine.py) — but the mobile/IoT deployment mode keeps the
+reference's wire contract (reference fedml_core/distributed/communication/
+message.py:5-74): a msg_type + sender + receiver header with arbitrary
+JSON-serializable params, arrays encoded as nested lists exactly like the
+reference's `transform_tensor_to_list` (fedavg/utils.py:118) for
+`is_mobile` payloads.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+MSG_ARG_KEY_TYPE = "msg_type"
+MSG_ARG_KEY_SENDER = "sender"
+MSG_ARG_KEY_RECEIVER = "receiver"
+
+
+class Message:
+    def __init__(self, msg_type: int | str = 0, sender_id: int = 0,
+                 receiver_id: int = 0):
+        self.msg_params: dict[str, Any] = {
+            MSG_ARG_KEY_TYPE: msg_type,
+            MSG_ARG_KEY_SENDER: sender_id,
+            MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # reference surface (message.py:23-58)
+    def add_params(self, key: str, value: Any):
+        self.msg_params[key] = value
+
+    def get_params(self) -> dict[str, Any]:
+        return self.msg_params
+
+    def add(self, key: str, value: Any):
+        self.msg_params[key] = value
+
+    def get(self, key: str) -> Any:
+        return self.msg_params[key]
+
+    def get_type(self):
+        return self.msg_params[MSG_ARG_KEY_TYPE]
+
+    def get_sender_id(self):
+        return self.msg_params[MSG_ARG_KEY_SENDER]
+
+    def get_receiver_id(self):
+        return self.msg_params[MSG_ARG_KEY_RECEIVER]
+
+    def add_model_params(self, key: str, tree: Any):
+        """Arrays -> nested lists (the reference's mobile JSON encoding)."""
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        self.msg_params[key] = {
+            "leaves": [np.asarray(l).tolist() for l in leaves],
+            "treedef": str(treedef),
+        }
+
+    @staticmethod
+    def decode_model_params(payload: dict, example_tree: Any) -> Any:
+        """Nested lists -> pytree with example_tree's structure/dtypes."""
+        import jax
+
+        leaves = [np.asarray(l, dtype=np.asarray(e).dtype)
+                  for l, e in zip(payload["leaves"], jax.tree.leaves(example_tree))]
+        return jax.tree.unflatten(jax.tree.structure(example_tree), leaves)
+
+    def to_json(self) -> str:
+        return json.dumps(self.msg_params)
+
+    @classmethod
+    def from_json(cls, s: str | bytes) -> "Message":
+        m = cls()
+        m.msg_params = json.loads(s)
+        return m

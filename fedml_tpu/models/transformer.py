@@ -29,9 +29,9 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * dm, use_bias=False, name="qkv")(h)
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, hd),
                             3, axis=2)  # each [B, T, H, hd]
-        # flash kernel wants block-divisible T; block = min(128, T) and T a
-        # multiple of it — guaranteed for T <= 128 or T % 128 == 0
-        blk = t if t < 128 else 128
+        # flash kernel wants block-divisible T: pick the largest power-of-two
+        # divisor of T up to 128 (any T works; odd T degenerates to blk=1)
+        blk = next(bb for bb in (128, 64, 32, 16, 8, 4, 2, 1) if t % bb == 0)
         attn = flash_attention(q, k, v, True, blk, blk)
         attn = attn.reshape(b, t, dm)
         x = x + nn.Dense(dm, use_bias=False, name="proj")(attn)
@@ -50,6 +50,11 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         b, t = tokens.shape
+        if t > self.max_len:
+            # fail loudly: the gather would silently clamp every position
+            # past max_len onto the last positional embedding row
+            raise ValueError(f"sequence length {t} exceeds max_len "
+                             f"{self.max_len}; raise max_len")
         x = nn.Embed(self.vocab_size, self.d_model, name="tok_emb")(tokens)
         pos = nn.Embed(self.max_len, self.d_model, name="pos_emb")(
             jnp.arange(t)[None, :])

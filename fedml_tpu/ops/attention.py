@@ -40,44 +40,50 @@ def attention_reference(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, t_kv,
-                      q_block, scale, precision):
-    """One (batch*head, q_block) program: stream K/V blocks, online softmax."""
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
+                      causal, n_kb, q_block, k_block, scale, precision):
+    """Grid (batch*head, q_blocks, k_blocks): TPU iterates the last grid dim
+    sequentially, so the f32 scratch accumulators (numerator O, running max
+    M, denominator L) persist across the K-block sweep — K/V truly stream
+    through VMEM one [block_k, D] tile at a time."""
     from jax.experimental import pallas as pl
 
-    qb = q_ref[:].astype(jnp.float32) * scale  # [block_q, D]
-    block_q = qb.shape[0]
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    def body(i, carry):
-        o, m, l = carry
-        kb = k_ref[pl.dslice(i * block_k, block_k), :]
-        vb = v_ref[pl.dslice(i * block_k, block_k), :]
+    @pl.when(ki == 0)
+    def _init():
+        o_scr[:] = jnp.zeros_like(o_scr)
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # causal: K blocks strictly after this Q block's last row are all masked
+    live = (ki * k_block <= (qi + 1) * q_block - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _block():
+        qb = q_ref[:].astype(jnp.float32) * scale   # [block_q, D]
+        kb = k_ref[:]                                # [block_k, D]
+        vb = v_ref[:]
         s = jax.lax.dot(qb, kb.astype(jnp.float32).T, precision=precision)
         if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_idx = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = ki * k_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+        m = m_scr[:]
         m_new = jnp.maximum(m, s.max(axis=-1))
-        # exp(-inf - -inf) guards: rows with no valid keys keep m=-inf
+        # exp(-inf - -inf) guard: rows with no valid keys yet keep m=-inf
         alpha = jnp.exp(jnp.where(m == -jnp.inf, 0.0, m - m_new))
         p = jnp.exp(s - m_new[:, None])
-        l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[:, None] + jax.lax.dot(p, vb.astype(jnp.float32),
-                                             precision=precision)
-        return o, m_new, l
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        o_scr[:] = o_scr[:] * alpha[:, None] + jax.lax.dot(
+            p, vb.astype(jnp.float32), precision=precision)
+        m_scr[:] = m_new
 
-    n_kb = t_kv // block_k
-    if causal:
-        # blocks strictly after this q block's last row contribute nothing
-        n_live = jnp.minimum(n_kb, ((qi + 1) * q_block - 1) // block_k + 1)
-    else:
-        n_live = n_kb
-    o = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, n_live, body, (o, m, l))
-    o_ref[:] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == n_kb - 1)
+    def _finalize():
+        o_ref[:] = (o_scr[:] / jnp.maximum(l_scr[:], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -100,19 +106,28 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
     # precision einsum drifts ~1e-2); bf16 inputs keep native MXU speed
     precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
                  else jax.lax.Precision.DEFAULT)
+    n_kb = tk // block_k
     kernel = functools.partial(
-        _flash_fwd_kernel, causal=causal, block_k=block_k, t_kv=tk,
-        q_block=block_q, scale=1.0 / np.sqrt(d), precision=precision)
+        _flash_fwd_kernel, causal=causal, n_kb=n_kb,
+        q_block=block_q, k_block=block_k,
+        scale=1.0 / np.sqrt(d), precision=precision)
+    from jax.experimental.pallas import tpu as pltpu
+
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, tq // block_q),
+        grid=(b * h, tq // block_q, n_kb),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
-            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
-            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i, j: (g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i, j: (g, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -44,8 +44,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         qf = q.astype(jnp.float32) * scale
         q_pos = d_idx * t_local + jnp.arange(t_local)
 
-        def step(carry, t):
-            o, m, l, kb, vb = carry
+        def block_update(o, m, l, kb, vb, t):
             src = (d_idx - t) % n  # which device's block we hold at step t
             s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
             if causal:
@@ -58,18 +57,26 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
             l = l * alpha + p.sum(axis=-1)
             o = o * alpha[..., None] + jnp.einsum(
                 "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+            return o, m_new, l
+
+        def step(carry, t):
+            o, m, l, kb, vb = carry
+            o, m, l = block_update(o, m, l, kb, vb, t)
             # rotate K/V blocks one hop around the ring
             perm = [(i, (i + 1) % n) for i in range(n)]
             kb = jax.lax.ppermute(kb, axis, perm)
             vb = jax.lax.ppermute(vb, axis, perm)
-            return (o, m_new, l, kb, vb), None
+            return (o, m, l, kb, vb), None
 
         b, _, h, dd = q.shape
         o0 = jnp.zeros((b, h, t_local, dd), jnp.float32)
         m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((b, h, t_local), jnp.float32)
-        (o, m, l, _, _), _ = jax.lax.scan(
-            step, (o0, m0, l0, k, v), jnp.arange(n))
+        # n-1 compute+rotate hops in the scan, final block computed outside —
+        # no wasted last rotation on the ICI ring
+        (o, m, l, kb, vb), _ = jax.lax.scan(
+            step, (o0, m0, l0, k, v), jnp.arange(n - 1))
+        o, m, l = block_update(o, m, l, kb, vb, n - 1)
         out = o / jnp.maximum(l, 1e-30)[..., None]
         return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, T/n, H, D]
 

@@ -1,0 +1,93 @@
+"""Mobile MQTT transport tests: the dependency-free MQTT 3.1.1 codec,
+in-process broker, and the reference topic scheme carrying a model pytree
+as a JSON Message (reference mqtt_comm_manager.py:14-125 + the is_mobile
+list encoding)."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm import Message, MiniBroker, MqttClient, MqttCommManager
+
+
+def test_mqtt_pubsub_roundtrip():
+    broker = MiniBroker()
+    try:
+        got = []
+        done = threading.Event()
+        sub = MqttClient(broker.host, broker.port, "sub")
+        sub.subscribe("t/1", lambda t, p: (got.append((t, p)), done.set()))
+        pub = MqttClient(broker.host, broker.port, "pub")
+        pub.publish("t/1", b"hello mqtt")
+        assert done.wait(10)
+        assert got == [("t/1", b"hello mqtt")]
+        sub.disconnect()
+        pub.disconnect()
+    finally:
+        broker.close()
+
+
+def test_mqtt_comm_manager_model_exchange():
+    """Server broadcasts a model pytree to a client over the reference topic
+    scheme; the client replies; both decode bit-exactly."""
+    broker = MiniBroker()
+    try:
+        server = MqttCommManager(broker.host, broker.port, client_id=0, client_num=2)
+        client1 = MqttCommManager(broker.host, broker.port, client_id=1)
+
+        tree = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                "b": jnp.ones((3,), jnp.float32)}
+        received = {}
+        c_done, s_done = threading.Event(), threading.Event()
+
+        def on_client(msg_type, msg):
+            received["client"] = Message.decode_model_params(msg.get("model"), tree)
+            c_done.set()
+
+        def on_server(msg_type, msg):
+            received["server_sender"] = msg.get_sender_id()
+            s_done.set()
+
+        client1.add_observer(on_client)
+        server.add_observer(on_server)
+
+        m = Message(msg_type=2, sender_id=0, receiver_id=1)
+        m.add_model_params("model", tree)
+        server.send_message(m)
+        assert c_done.wait(10)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(received["client"][k]),
+                                          np.asarray(tree[k]))
+
+        reply = Message(msg_type=3, sender_id=1, receiver_id=0)
+        reply.add("train_acc", 0.9)
+        client1.send_message(reply)
+        assert s_done.wait(10)
+        assert received["server_sender"] == 1
+
+        server.stop()
+        client1.stop()
+    finally:
+        broker.close()
+
+
+def test_mqtt_multiple_subscribers_fanout():
+    broker = MiniBroker()
+    try:
+        hits = []
+        evs = [threading.Event() for _ in range(2)]
+        subs = []
+        for i in range(2):
+            c = MqttClient(broker.host, broker.port, f"s{i}")
+            c.subscribe("fan", lambda t, p, i=i: (hits.append(i), evs[i].set()))
+            subs.append(c)
+        pub = MqttClient(broker.host, broker.port, "p")
+        pub.publish("fan", b"x")
+        assert all(e.wait(10) for e in evs)
+        assert sorted(hits) == [0, 1]
+        for c in subs + [pub]:
+            c.disconnect()
+    finally:
+        broker.close()
