@@ -96,9 +96,9 @@ python -m fedml_tpu.experiments.main_decentralized --run_dir "$RUN_DIR" \
 
 echo "== fedgkt"
 python -m fedml_tpu.experiments.main_fedgkt $COMMON --dataset cifar10 \
-  --client_num_in_total 8 --client_num_per_round 8 --comm_round 1 \
-  --epochs 1 --epochs_server 1 --batch_size 64 --partition_method homo \
-  --server_blocks 1 1 1
+  --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 \
+  --epochs 1 --epochs_server 1 --batch_size 32 --partition_method homo \
+  --server_blocks 1 1 1 --client_sample_cap 64
 assert_summary "Test/Acc" 0.0 1.0
 
 echo "== split_nn"

@@ -24,8 +24,27 @@ def main(argv=None):
     parser.add_argument("--alpha", type=float, default=1.0)
     parser.add_argument("--client_blocks", type=int, default=1)
     parser.add_argument("--server_blocks", type=int, nargs=3, default=None)
+    parser.add_argument("--client_sample_cap", type=int, default=None,
+                        help="truncate each client's local data to N samples "
+                             "(quick experiments / CI; GKT trains the FULL "
+                             "federation every round, so work scales with "
+                             "total samples, not clients-per-round)")
     args = parser.parse_args(argv)
     cfg, ds, _trainer = setup_run(args)
+    if args.client_sample_cap:
+        import dataclasses
+
+        import numpy as np
+
+        from fedml_tpu.data.packing import PackedClients
+
+        cap = args.client_sample_cap
+        ds = dataclasses.replace(
+            ds,
+            train=PackedClients(ds.train.x[:, :cap], ds.train.y[:, :cap],
+                                np.minimum(ds.train.counts, cap)),
+            test_global=(ds.test_global[0][:512], ds.test_global[1][:512]),
+        )
     client = GKTClientResNet(output_dim=ds.class_num, num_blocks=args.client_blocks)
     server_kw = {"output_dim": ds.class_num}
     if args.server_blocks:

@@ -13,9 +13,16 @@ if not os.environ.get("FEDML_TPU_TESTS_ON_TPU"):
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         flags += " --xla_force_host_platform_device_count=8"
-    if "xla_backend_optimization_level" not in flags:
-        # the suite is compile-bound on CPU and test workloads are tiny, so
-        # trading codegen quality for compile time roughly halves wall-clock
+    import sys
+
+    _runslow = ("--runslow" in sys.argv
+                or os.environ.get("FEDML_TPU_RUN_SLOW"))
+    if "xla_backend_optimization_level" not in flags and not _runslow:
+        # the fast suite is compile-bound on CPU and its workloads are tiny,
+        # so trading codegen quality for compile time roughly halves
+        # wall-clock. The --runslow tests are RUNTIME-heavy (real training
+        # sweeps), where opt-0 codegen would cost far more than it saves —
+        # they keep the default optimization level.
         flags += " --xla_backend_optimization_level=0"
     os.environ["XLA_FLAGS"] = flags
 

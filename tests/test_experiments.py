@@ -113,10 +113,10 @@ def test_main_fedgkt_cli(tmp_path):
 
     hist = main([
         "--dataset", "cifar10", "--partition_method", "homo",
-        "--client_num_in_total", "8", "--client_num_per_round", "8",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
         "--comm_round", "1", "--epochs", "1", "--epochs_server", "1",
-        "--batch_size", "64", "--lr", "0.05", "--server_blocks", "1", "1", "1",
-        "--run_dir", str(tmp_path / "run"),
+        "--batch_size", "32", "--lr", "0.05", "--server_blocks", "1", "1", "1",
+        "--client_sample_cap", "64", "--run_dir", str(tmp_path / "run"),
     ])
     assert len(hist) == 1
     summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())

@@ -265,7 +265,7 @@ def test_main_privacy_cli_blockensemble(tmp_path):
 
     _hist, final = main([
         "--dataset", "mnist", "--partition_method", "homo",
-        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--client_num_in_total", "16", "--client_num_per_round", "4",
         "--comm_round", "1", "--epochs", "1", "--batch_size", "32",
         "--lr", "0.1", "--branch_num", "3", "--ensemble_method",
         "blockensemble", "--run_dir", str(tmp_path / "run"),
@@ -286,7 +286,7 @@ def test_main_privacy_cli_predweight(tmp_path):
         "--dataset", "mnist", "--partition_method", "homo",
         "--comm_round", "1", "--epochs", "1", "--batch_size", "32",
         "--lr", "0.1", "--branch_num", "2", "--ensemble_method", "predweight",
-        "--no_mi_attack", "--client_num_in_total", "8",
+        "--no_mi_attack", "--client_num_in_total", "16",
         "--client_num_per_round", "4", "--run_dir", str(tmp_path / "run"),
     ])
     summary = json.loads((tmp_path / "run" / "wandb-summary.json").read_text())
