@@ -52,7 +52,11 @@ def make_server_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
     if name == "sgd":
         return optax.sgd(cfg.server_lr, momentum=cfg.server_momentum or None)
     if name == "adam":
-        return optax.adam(cfg.server_lr, b1=0.9, b2=0.99, eps=1e-3)
+        # torch.optim.Adam defaults (the reference instantiates OptRepo
+        # classes with lr only, FedOptAggregator.py:40-43) — betas (0.9,
+        # 0.999), eps 1e-8; verified against the living reference by
+        # tests/test_reference_parity.py::test_fedopt_server_parity
+        return optax.adam(cfg.server_lr)
     if name == "yogi":
         return optax.yogi(cfg.server_lr)
     if name == "adagrad":

@@ -39,6 +39,49 @@ class LocalResult(NamedTuple):
     metrics: dict  # summed train metrics of the final epoch
 
 
+class _TorchAmsgradState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+    nu_max: Any
+
+
+def scale_by_torch_amsgrad(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> "optax.GradientTransformation":
+    """torch.optim.Adam(amsgrad=True) numerics, exactly.
+
+    optax.amsgrad maxes over *bias-corrected* second moments
+    (max_t v_t/(1-b2^t)); torch maxes the raw moment and applies the CURRENT
+    step's correction after (max_t(v_t)/(1-b2^T)) — the trajectories diverge
+    measurably (caught by tests/test_reference_parity.py, ~2e-2 after 10
+    steps). Reference client path: my_model_trainer_classification.py:28-29.
+    """
+
+    def init_fn(params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return _TorchAmsgradState(jnp.zeros([], jnp.int32), z, z, z)
+
+    def update_fn(updates, state, params=None):
+        del params
+        t = state.count + 1
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
+        nu_max = jax.tree.map(jnp.maximum, state.nu_max, nu)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        bc2 = 1 - b2 ** t.astype(jnp.float32)
+        out = jax.tree.map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu, nu_max
+        )
+        return out, _TorchAmsgradState(t, mu, nu, nu_max)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def torch_amsgrad(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    return optax.chain(scale_by_torch_amsgrad(b1, b2, eps), optax.scale(-lr))
+
+
 def make_local_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
     """Client optimizer matching reference trainer construction
     (my_model_trainer_classification.py:25-31: SGD(lr) or Adam(lr, wd,
@@ -55,7 +98,7 @@ def make_local_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
         # *before* adaptive scaling (not adamw-style decoupled decay)
         if cfg.wd:
             chain.append(optax.add_decayed_weights(cfg.wd))
-        chain.append(optax.amsgrad(cfg.lr))
+        chain.append(torch_amsgrad(cfg.lr))
     else:
         raise ValueError(f"unknown client_optimizer {cfg.client_optimizer!r}")
     return optax.chain(*chain)
@@ -99,9 +142,14 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
         def epoch_body(carry, erng):
             variables, opt_state, steps = carry
             shuffle_rng, step_rng = jax.random.split(erng)
-            u = jax.random.uniform(shuffle_rng, (n_max,))
-            valid = jnp.arange(n_max) < count
-            perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+            if cfg.shuffle:
+                u = jax.random.uniform(shuffle_rng, (n_max,))
+                valid = jnp.arange(n_max) < count
+                perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+            else:
+                # fixed-order epochs: data is packed valid-prefix-first, so
+                # identity order == torch DataLoader(shuffle=False)
+                perm = jnp.arange(n_max)
             if n_pad > n_max:
                 perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
             # ONE epoch-level gather instead of a gather per step: scan then

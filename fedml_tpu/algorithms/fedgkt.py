@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.algorithms.engine import torch_amsgrad
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
 from fedml_tpu.utils.pytree import tree_where
@@ -79,7 +80,7 @@ def _make_gkt_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
             chain.append(optax.add_decayed_weights(cfg.wd))
         chain.append(optax.sgd(cfg.lr, momentum=0.9, nesterov=True))
         return optax.chain(*chain)
-    return optax.chain(optax.add_decayed_weights(1e-4), optax.amsgrad(cfg.lr))
+    return optax.chain(optax.add_decayed_weights(1e-4), torch_amsgrad(cfg.lr))
 
 
 def _epoch_batches(x, y, extra, count, b, rng):

@@ -42,6 +42,11 @@ class FedConfig:
     # reference my_model_trainer_classification.py:44 clips unconditionally at
     # 1.0 every step ("to avoid nan loss") — same default here; None disables
     grad_clip: float | None = 1.0
+    # torch DataLoader(shuffle=True) analog. False = iterate each client's
+    # samples in stored order (valid prefix), which makes minibatch
+    # trajectories bit-comparable with a fixed-order reference DataLoader —
+    # the reference-parity oracle (tests/test_reference_parity.py) relies on it
+    shuffle: bool = True
 
     # federated loop
     comm_round: int = 10
