@@ -67,6 +67,12 @@ assert abs(fa - ca) < 1e-3, (fa, ca)
 print(f"OK equivalence: fedavg={fa:.4f} centralized={ca:.4f}")
 EOF
 
+echo "== fedavg over MQTT (mobile transport: broker + actor loops)"
+python -m fedml_tpu.experiments.main_mqtt_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 2 \
+  --epochs 1 --batch_size 8
+assert_summary "Test/Acc" 0.0 1.0
+
 echo "== fedopt"
 python -m fedml_tpu.experiments.main_fedopt $COMMON --dataset mnist --model lr \
   --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 4

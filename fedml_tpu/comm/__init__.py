@@ -2,3 +2,9 @@
 
 from fedml_tpu.comm.message import Message  # noqa: F401
 from fedml_tpu.comm.mqtt import MiniBroker, MqttClient, MqttCommManager  # noqa: F401
+from fedml_tpu.comm.mqtt_fedavg import (  # noqa: F401
+    MqttFedAvgClientManager,
+    MqttFedAvgServerManager,
+    MyMessage,
+    run_mqtt_fedavg,
+)

@@ -187,7 +187,9 @@ class MqttClient:
         self._cbs: dict[str, Callable[[str, bytes], None]] = {}
         self._pid = 0
         self._send_lock = threading.Lock()  # publish/subscribe from any thread
-        self._suback = threading.Event()
+        # SUBACKs are matched to their SUBSCRIBE by packet id so concurrent
+        # subscribers never return on each other's ack
+        self._pending_subacks: dict[int, threading.Event] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -204,18 +206,24 @@ class MqttClient:
                     if cb is not None:
                         cb(topic, body[2 + tlen:])
                 elif ptype == SUBACK & 0xF0:
-                    self._suback.set()
+                    pid = struct.unpack(">H", body[:2])[0]
+                    ev = self._pending_subacks.pop(pid, None)
+                    if ev is not None:
+                        ev.set()
         except (ConnectionError, OSError):
             pass
 
     def subscribe(self, topic: str, callback: Callable[[str, bytes], None],
                   timeout: float = 10.0):
         self._cbs[topic] = callback
-        self._pid += 1
-        self._suback.clear()
+        ev = threading.Event()
         with self._send_lock:
-            self._sock.sendall(_subscribe_packet(self._pid, topic))
-        if not self._suback.wait(timeout):
+            self._pid = (self._pid % 0xFFFF) + 1
+            pid = self._pid
+            self._pending_subacks[pid] = ev
+            self._sock.sendall(_subscribe_packet(pid, topic))
+        if not ev.wait(timeout):
+            self._pending_subacks.pop(pid, None)
             raise TimeoutError(f"no SUBACK for {topic!r}")
 
     def publish(self, topic: str, payload: bytes):
