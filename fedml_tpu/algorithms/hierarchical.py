@@ -85,7 +85,6 @@ class HierarchicalFLAPI:
         self.groups = group_assignment
         if any(len(g) == 0 for g in self.groups):
             raise ValueError("every group needs at least one client")
-        self.round_fn = build_hierarchical_round_fn(trainer, cfg, group_comm_round)
         self.eval_fn = build_eval_fn(trainer)
         # group assignment is fixed — stack [G, C, ...] arrays once, not per
         # round. Ragged groups (the reference accepts arbitrary splits,
@@ -104,6 +103,47 @@ class HierarchicalFLAPI:
         self._x = jnp.asarray(np.stack(xs))
         self._y = jnp.asarray(np.stack(ys))
         self._counts = jnp.asarray(np.stack(cs))
+
+        if cfg.backend == "shard_map":
+            # two-level (groups, clients) mesh deployment (SURVEY §2.9):
+            # in-group psum per inner round over ICI, one cross-group psum
+            # per global round. Pad both axes to the mesh shape with
+            # zero-count clients (weight-0 no-ops at both levels).
+            import math as _math
+
+            from fedml_tpu.parallel import (
+                build_sharded_hierarchical_round_fn,
+                make_mesh,
+            )
+
+            n_dev = len(jax.devices())
+            g = self._x.shape[0]
+            if len(cfg.mesh_shape) == 2:
+                g_dev, c_dev = cfg.mesh_shape
+                if g % g_dev:
+                    raise ValueError(
+                        f"mesh_shape groups axis {g_dev} must divide "
+                        f"group_num {g}"
+                    )
+            else:
+                g_dev = _math.gcd(g, n_dev)
+                c_dev = n_dev // g_dev
+            c = self._x.shape[1]
+            c_pad = -c % c_dev
+            if c_pad:
+                zx = jnp.zeros((g, c_pad) + self._x.shape[2:], self._x.dtype)
+                zy = jnp.zeros((g, c_pad) + self._y.shape[2:], self._y.dtype)
+                self._x = jnp.concatenate([self._x, zx], axis=1)
+                self._y = jnp.concatenate([self._y, zy], axis=1)
+                self._counts = jnp.concatenate(
+                    [self._counts, jnp.zeros((g, c_pad), self._counts.dtype)], axis=1
+                )
+            mesh = make_mesh((g_dev, c_dev), ("groups", "clients"))
+            self.round_fn = build_sharded_hierarchical_round_fn(
+                trainer, cfg, mesh, group_comm_round
+            )
+        else:
+            self.round_fn = build_hierarchical_round_fn(trainer, cfg, group_comm_round)
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.global_variables = trainer.init(rng, jnp.asarray(dataset.train.x[:1, 0]))

@@ -7,5 +7,8 @@ state_dicts, reference com_manager.py:13-101): the "cluster" is a
 server's weighted average is an XLA collective over ICI.
 """
 
+from fedml_tpu.parallel.hierarchical import (  # noqa: F401
+    build_sharded_hierarchical_round_fn,
+)
 from fedml_tpu.parallel.mesh import make_mesh  # noqa: F401
 from fedml_tpu.parallel.sharded import build_sharded_round_fn  # noqa: F401

@@ -1,0 +1,122 @@
+"""Two-level (groups, clients) mesh for hierarchical FL.
+
+SURVEY §2.9 maps the reference's cloud→group→client nesting
+(standalone/hierarchical_fl/trainer.py:43-71, group.py:24-46) onto a
+two-level device mesh: ICI within a slice hosts a group's clients, the
+cross-slice (DCN-reaching) axis is the cloud. Concretely:
+
+  - clients are sharded over BOTH mesh axes: x is [G, C, n_max, ...] with G
+    split over the `groups` axis and C over the `clients` axis;
+  - each inner group round ends in a weighted `psum` over the `clients`
+    axis only — the group-local all-reduce that rides ICI;
+  - after `group_comm_round` inner rounds, the cloud average is a weighted
+    `psum` over the `groups` axis — the only traffic that crosses slices,
+    once per global round instead of once per inner round (the whole point
+    of hierarchical FL's communication hierarchy).
+
+Per-group/per-client RNG keys are assigned from the same nested split tables
+as the single-chip `build_hierarchical_round_fn`, so the sharded round
+reproduces the vmap round to float tolerance (asserted in
+tests/test_parallel.py and in the driver dryrun).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedml_tpu.algorithms.engine import build_local_update
+from fedml_tpu.core.config import FedConfig
+
+
+def build_sharded_hierarchical_round_fn(
+    trainer,
+    cfg: FedConfig,
+    mesh: Mesh,
+    group_comm_round: int,
+    group_axis: str = "groups",
+    client_axis: str = "clients",
+) -> Callable:
+    """Jitted two-level round over a (groups, clients) mesh.
+
+    Inputs mirror build_hierarchical_round_fn: x/y/counts are group-major
+    [G, C, n_max, ...]; G must divide by mesh.shape[group_axis] and C by
+    mesh.shape[client_axis] (pad with zero-count clients / empty groups —
+    weight-0 no-ops at both averaging levels).
+    """
+    local_update = build_local_update(trainer, cfg)
+    g_dev = mesh.shape[group_axis]
+    c_dev = mesh.shape[client_axis]
+
+    def shard_body(global_variables, x, y, counts, rng):
+        g_loc, c_loc = x.shape[0], x.shape[1]
+        g_total, c_total = g_loc * g_dev, c_loc * c_dev
+        gidx = jax.lax.axis_index(group_axis)
+        cidx = jax.lax.axis_index(client_axis)
+        # same group-key table as the vmap engine: split(rng, G)[g]
+        all_grngs = jax.random.split(rng, g_total)
+        grngs = jax.lax.dynamic_slice_in_dim(all_grngs, gidx * g_loc, g_loc)
+
+        def group_train(gv, xg, yg, cg, grng):
+            def inner_round(gv, r_rng):
+                # same client-key table: split(r_rng, C)[c]
+                all_crngs = jax.random.split(r_rng, c_total)
+                crngs = jax.lax.dynamic_slice_in_dim(all_crngs, cidx * c_loc, c_loc)
+                result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+                    gv, xg, yg, cg, crngs
+                )
+                # group-local weighted mean == psum over the clients axis
+                # (ICI); denominator guarded so an empty padded group
+                # produces zeros (weight-0 at the cloud level), not NaN
+                w = cg.astype(jnp.float32)
+                wn = w / jnp.maximum(jax.lax.psum(w.sum(), client_axis), 1e-12)
+
+                def avg(leaf):
+                    wb = wn.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+                    return jax.lax.psum(jnp.sum(leaf * wb, axis=0), client_axis)
+
+                new_gv = jax.tree.map(avg, result.variables)
+                metrics = {
+                    k: jax.lax.psum(v.sum(), client_axis)
+                    for k, v in result.metrics.items()
+                }
+                return new_gv, metrics
+
+            gv, ms = jax.lax.scan(
+                inner_round, gv, jax.random.split(grng, group_comm_round)
+            )
+            return gv, {k: v[-1] for k, v in ms.items()}
+
+        group_vars, metrics = jax.vmap(group_train, in_axes=(None, 0, 0, 0, 0))(
+            global_variables, x, y, counts, grngs
+        )
+        # cloud level: weighted mean over groups — the once-per-global-round
+        # cross-slice reduction
+        gw = jax.lax.psum(counts.sum(axis=1).astype(jnp.float32), client_axis)
+        gwn = gw / jnp.maximum(jax.lax.psum(gw.sum(), group_axis), 1e-12)
+
+        def cloud_avg(leaf):
+            wb = gwn.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+            return jax.lax.psum(jnp.sum(leaf * wb, axis=0), group_axis)
+
+        new_global = jax.tree.map(cloud_avg, group_vars)
+        out_metrics = {
+            k: jax.lax.psum(v.sum(), group_axis) for k, v in metrics.items()
+        }
+        return new_global, out_metrics
+
+    def round_fn(global_variables, x, y, counts, rng):
+        sharded = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(), P(group_axis, client_axis), P(group_axis, client_axis),
+                      P(group_axis, client_axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return sharded(global_variables, x, y, counts, rng)
+
+    return jax.jit(round_fn)

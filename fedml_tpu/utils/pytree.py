@@ -20,7 +20,10 @@ def tree_weighted_mean(stacked_tree, weights):
     e.g. per-client sample counts — reference FedAVGAggregator.py:72-80 uses
     `local_sample_number / training_num`).
     """
-    w = weights / jnp.sum(weights)
+    # guarded denominator: an all-zero weight vector (e.g. an empty padded
+    # group in hierarchical FL) yields a zero mean instead of NaN, which is
+    # then a weight-0 no-op at the next averaging level
+    w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
 
     def avg(leaf):
         wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)

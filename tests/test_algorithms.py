@@ -149,6 +149,23 @@ def test_hierarchical_learns(mnist12):
     assert hist[-1]["Test/Acc"] > 0.5
 
 
+def test_hierarchical_shard_map_backend_equals_vmap(mnist12):
+    """API-level: the two-level (groups, clients) mesh backend reproduces the
+    vmap backend (ragged 12 clients over 3 groups pad to the mesh shape).
+    Full-batch so the padded client axis's different RNG key table is inert
+    (shuffle is permutation-invariant at full batch; LR has no dropout)."""
+    cfg = FedConfig(batch_size=-1, epochs=1, lr=0.1, comm_round=1,
+                    client_num_in_total=12, client_num_per_round=12)
+    t = _trainer()
+    vm = HierarchicalFLAPI(mnist12, cfg, t, group_num=3, group_comm_round=2)
+    sm = HierarchicalFLAPI(mnist12, cfg.replace(backend="shard_map"), t,
+                           group_num=3, group_comm_round=2)
+    sm.global_variables = jax.tree.map(lambda x: x, vm.global_variables)
+    vm.train_one_round(0)
+    sm.train_one_round(0)
+    assert _maxdiff(vm.global_variables, sm.global_variables) < 1e-5
+
+
 # ------------------------------------------------------------- decentralized
 
 def _streaming_data(n_nodes=8, T=30, dim=12, seed=0):

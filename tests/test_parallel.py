@@ -100,6 +100,54 @@ def test_zero_count_client_padding_is_noop(mesh8, ds16):
     assert max(jax.tree.leaves(d2)) < 1e-4
 
 
+def test_two_level_hierarchical_mesh_equals_vmap(ds16):
+    """(groups, clients) mesh round == vmapped hierarchical round: in-group
+    psum over the clients axis each inner round, one cross-group psum per
+    global round (SURVEY §2.9 hierarchical mapping)."""
+    from fedml_tpu.algorithms.hierarchical import build_hierarchical_round_fn
+    from fedml_tpu.parallel import build_sharded_hierarchical_round_fn
+
+    cfg = FedConfig(batch_size=8, epochs=1, lr=0.05,
+                    client_num_in_total=16, client_num_per_round=16)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds16.class_num))
+    rng = jax.random.PRNGKey(3)
+    gv = trainer.init(rng, jnp.asarray(ds16.train.x[:1, 0]))
+
+    # 2 groups x 8 clients, group-major [G, C, ...]
+    x, y, counts = ds16.train.select(np.arange(16))
+    x = jnp.asarray(x).reshape((2, 8) + x.shape[1:])
+    y = jnp.asarray(y).reshape((2, 8) + y.shape[1:])
+    counts = jnp.asarray(counts).reshape(2, 8)
+
+    mesh = make_mesh((2, 4), ("groups", "clients"))
+    vmap_round = build_hierarchical_round_fn(trainer, cfg, group_comm_round=3)
+    shard_round = build_sharded_hierarchical_round_fn(
+        trainer, cfg, mesh, group_comm_round=3
+    )
+
+    g1, m1 = vmap_round(gv, x, y, counts, rng)
+    g2, m2 = shard_round(gv, x, y, counts, rng)
+
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g2)
+    assert max(jax.tree.leaves(d)) < 1e-6
+    for k in m1:
+        assert abs(float(m1[k]) - float(m2[k])) < 1e-3
+
+    # empty padded group (all-zero counts) must be a weight-0 no-op at the
+    # cloud level, not NaN — pad 2 real groups to a (4, 2) mesh
+    mesh42 = make_mesh((4, 2), ("groups", "clients"))
+    xp = jnp.concatenate([x, jnp.zeros_like(x)], axis=0)
+    yp = jnp.concatenate([y, jnp.zeros_like(y)], axis=0)
+    cp = jnp.concatenate([counts, jnp.zeros_like(counts)], axis=0)
+    shard42 = build_sharded_hierarchical_round_fn(
+        trainer, cfg, mesh42, group_comm_round=3
+    )
+    g3, _ = shard42(gv, xp, yp, cp, rng)
+    assert all(bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(g3))
+    d3 = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g3)
+    assert max(jax.tree.leaves(d3)) < 1e-6
+
+
 def test_multihost_helpers_single_process():
     """Single-process degradation of the cross-silo helpers (the multi-host
     path needs real multi-process; the API contract is testable here)."""
