@@ -227,33 +227,113 @@ def load_emnist(data_dir="./data", client_num_in_total=10, partition_method="hom
 
 @register_loader("ILSVRC2012")
 def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
-                  image_size=224, cap_per_class=None, **_):
+                  image_size=224, cap_per_class=None, byte_budget=None,
+                  global_cap=512, samples_per_client=2048, **_):
     """ImageNet partitioned by class blocks: with 100 clients each owns 10
     consecutive classes, with 1000 each owns one (reference
     ImageNet/data_loader.py:190-240 / datasets.py:81-129 net_dataidx_map).
-    Reads the ILSVRC2012 folder tree; surrogate when absent."""
-    from fedml_tpu.data import readers
 
-    ref = None
-    try:
-        ref = readers.read_imagenet_folder(data_dir, image_size, cap_per_class)
-    except Exception as e:
-        sources.log.warning("failed reading ImageNet tree (%s)", e)
-    if ref is not None:
-        xtr, ytr, xte, yte, classes = ref
+    When the ILSVRC2012 folder tree is present the dataset STREAMS: only
+    file paths are scanned eagerly; a round's `select()` decodes just its
+    sampled clients under an LRU byte budget (data/streaming.py — the
+    reference's lazy per-batch DataLoader equivalent; the full train split
+    at 224px would be ~700 GB as float32). `train_global`/`test_global`
+    carry a decoded subset of `global_cap` samples for the centralized-oracle
+    and eval paths. Surrogate when the tree is absent."""
+    import os as _os
+
+    from fedml_tpu.data import readers
+    from fedml_tpu.data.streaming import (
+        StreamingPackedClients,
+        make_image_decoder,
+    )
+
+    tr_root = _os.path.join(data_dir, "train")
+    te_root = _os.path.join(data_dir, "val")
+    scan = None
+    if _os.path.isdir(tr_root) and _os.path.isdir(te_root):
+        try:
+            scan = (readers.list_image_folder_files(tr_root),
+                    readers.list_image_folder_files(te_root))
+        except Exception as e:
+            sources.log.warning("failed scanning ImageNet tree (%s)", e)
+    if scan is not None and scan[0] is not None and scan[1] is not None:
+        (tr_pc, classes), (te_pc, te_classes) = scan
+        if te_classes != classes:
+            raise ValueError(
+                f"ImageNet train/val class dirs disagree ({len(classes)} vs "
+                f"{len(te_classes)}; first diff: "
+                f"{sorted(set(classes) ^ set(te_classes))[:3]}) — val labels "
+                "would be silently wrong. Complete the download or remove "
+                "the extra dirs.")
+        if cap_per_class is not None:
+            tr_pc = [f[:cap_per_class] for f in tr_pc]
+            te_pc = [f[:cap_per_class] for f in te_pc]
         class_num = len(classes)
-    else:
-        sources.log.warning("ImageNet folder tree not found under %s — using "
-                            "tiny seeded surrogate", data_dir)
-        class_num = max(10, client_num_in_total)
-        sz = min(image_size, 32)
-        xtr, ytr = sources.synthetic_image_classes(
-            class_num * 12, class_num, (sz, sz, 3), seed, proto_seed=seed + 1012)
-        xte, yte = sources.synthetic_image_classes(
-            class_num * 3, class_num, (sz, sz, 3), seed + 1, proto_seed=seed + 1012)
-    # class-blocked natural partition: classes are split across clients with
-    # array_split so EVERY class lands on exactly one client even when
-    # class_num % client_num != 0 (reference per-class net_dataidx_map)
+        dec = make_image_decoder(image_size, readers.IMAGENET_MEAN,
+                                 readers.IMAGENET_STD)
+        budget = int(byte_budget
+                     or _os.environ.get("FEDML_TPU_STREAM_BUDGET", 4 << 30))
+        # class-blocked natural partition: classes split with array_split so
+        # EVERY class lands on exactly one client even when
+        # class_num % client_num != 0 (reference per-class net_dataidx_map)
+        class_blocks = np.array_split(np.arange(class_num), client_num_in_total)
+        cf, cl = [], []
+        for block in class_blocks:
+            files, labels = [], []
+            for ci in block:
+                files.extend(tr_pc[ci])
+                labels.extend([ci] * len(tr_pc[ci]))
+            cf.append(files)
+            cl.append(np.asarray(labels, np.int32))
+        if samples_per_client is not None:
+            # a class-blocked ILSVRC client owns 1.3k-13k images; one padded
+            # row at 224px f32 is n_max*600KB, so cap each client's list with
+            # a seeded subsample to keep round memory inside the budget
+            srng = np.random.RandomState(seed + 7)
+            for k in range(len(cf)):
+                if len(cf[k]) > samples_per_client:
+                    keep = np.sort(srng.choice(len(cf[k]), samples_per_client,
+                                               replace=False))
+                    cf[k] = [cf[k][i] for i in keep]
+                    cl[k] = cl[k][keep]
+        train = StreamingPackedClients(cf, cl, dec, byte_budget=budget)
+        # homo-partitioned per-client test split over the val files
+        te_files = [f for ci in range(class_num) for f in te_pc[ci]]
+        te_labels = np.asarray(
+            [ci for ci in range(class_num) for _ in te_pc[ci]], np.int32)
+        te_map = homo_partition(len(te_files), client_num_in_total,
+                                np.random.RandomState(seed))
+        tef = [[te_files[i] for i in te_map[k]] for k in sorted(te_map)]
+        tel = [te_labels[te_map[k]] for k in sorted(te_map)]
+        test = StreamingPackedClients(tef, tel, dec, byte_budget=budget)
+        # capped decoded subsets for the *_global paths — RANDOM (seeded)
+        # samples, not the class-sorted prefix (which would cover only the
+        # lowest classes and silently skew eval / MI member sets)
+        from fedml_tpu.data.streaming import decode_global_subset
+
+        tr_flat = [(f, ci) for ci in range(class_num) for f in tr_pc[ci]]
+        xgt, ygt = decode_global_subset(
+            [f for f, _ in tr_flat], np.asarray([c for _, c in tr_flat], np.int32),
+            dec, global_cap, seed, (image_size, image_size, 3))
+        xg, yg = decode_global_subset(
+            te_files, te_labels, dec, global_cap, seed + 1,
+            (image_size, image_size, 3))
+        return FederatedDataset(
+            name="ILSVRC2012", train=train, test=test,
+            train_global=(xgt, ygt), test_global=(xg, yg),
+            class_num=class_num,
+            meta={"streaming": True, "global_cap": int(global_cap)},
+        )
+
+    sources.log.warning("ImageNet folder tree not found under %s — using "
+                        "tiny seeded surrogate", data_dir)
+    class_num = max(10, client_num_in_total)
+    sz = min(image_size, 32)
+    xtr, ytr = sources.synthetic_image_classes(
+        class_num * 12, class_num, (sz, sz, 3), seed, proto_seed=seed + 1012)
+    xte, yte = sources.synthetic_image_classes(
+        class_num * 3, class_num, (sz, sz, 3), seed + 1, proto_seed=seed + 1012)
     class_blocks = np.array_split(np.arange(class_num), client_num_in_total)
     order = np.argsort(ytr, kind="stable")
     xtr_l, ytr_l = [], []
@@ -274,35 +354,70 @@ def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
 
 def _register_landmarks(variant, default_clients):
     @register_loader(variant)
-    def _load(data_dir="./data", client_num_in_total=None, seed=0, image_size=64, **_):
+    def _load(data_dir="./data", client_num_in_total=None, seed=0, image_size=64,
+              global_cap=512, **_):
         """Google Landmarks user-split (reference Landmarks/data_loader.py:202
         load_partition_data_landmarks; gld23k = 233 users / 203 classes,
         gld160k = 1262 users / 2028 classes)."""
         from fedml_tpu.data import readers
 
         client_num = client_num_in_total or default_clients
-        ref = None
+        scan = None
         try:
-            ref = readers.read_landmarks(data_dir, variant, image_size)
+            scan = readers.list_landmarks_files(data_dir, variant)
         except Exception as e:
             sources.log.warning("failed reading %s (%s)", variant, e)
-        if ref is not None:
-            xtr_l, ytr_l, xte, yte, class_num = ref
-        else:
-            sources.log.warning("%s csv/images not found under %s — using tiny "
-                                "seeded surrogate", variant, data_dir)
-            class_num = 203 if variant == "gld23k" else 2028
-            rng = np.random.RandomState(seed)
-            protos = rng.normal(0, 1, (class_num, image_size, image_size, 3)).astype(np.float32)
-            xtr_l, ytr_l = [], []
-            for _c in range(client_num):
-                n_i = int(np.clip(rng.lognormal(3.0, 0.6), 4, 128))
-                y_i = rng.randint(0, class_num, n_i).astype(np.int32)
-                xtr_l.append(protos[y_i] * 0.6 +
-                             rng.normal(0, 0.35, (n_i, image_size, image_size, 3)).astype(np.float32))
-                ytr_l.append(y_i)
-            yte = rng.randint(0, class_num, 64).astype(np.int32)
-            xte = protos[yte] * 0.6 + rng.normal(0, 0.35, (64, image_size, image_size, 3)).astype(np.float32)
+        if scan is not None:
+            # stream: decode only sampled users per round (gld160k is 164 k
+            # images — the eager path the reference also avoids, its
+            # Landmarks/data_loader.py decodes per batch)
+            import os as _os
+
+            from fedml_tpu.data.streaming import (
+                StreamingPackedClients,
+                make_image_decoder,
+            )
+
+            files, labels, te_files, te_labels, class_num = scan
+            dec = make_image_decoder(image_size)
+            budget = int(_os.environ.get("FEDML_TPU_STREAM_BUDGET", 4 << 30))
+            train = StreamingPackedClients(files, labels, dec, byte_budget=budget)
+            te_map = homo_partition(len(te_files), len(files),
+                                    np.random.RandomState(seed))
+            tef = [[te_files[i] for i in te_map[k]] for k in sorted(te_map)]
+            tel = [te_labels[te_map[k]] for k in sorted(te_map)]
+            test = StreamingPackedClients(tef, tel, dec, byte_budget=budget)
+            # seeded random *_global subsets (prefix slicing would cover only
+            # the first users/classes and skew eval)
+            from fedml_tpu.data.streaming import decode_global_subset
+
+            shp = (image_size, image_size, 3)
+            xg, yg = decode_global_subset(te_files, te_labels, dec,
+                                          global_cap, seed + 1, shp)
+            gt_files = [f for fl in files for f in fl]
+            gt_labels = np.concatenate(labels)
+            xgt, ygt = decode_global_subset(gt_files, gt_labels, dec,
+                                            global_cap, seed, shp)
+            return FederatedDataset(
+                name=variant, train=train, test=test,
+                train_global=(xgt, ygt),
+                test_global=(xg, yg), class_num=int(class_num),
+                meta={"streaming": True, "global_cap": int(global_cap)},
+            )
+        sources.log.warning("%s csv/images not found under %s — using tiny "
+                            "seeded surrogate", variant, data_dir)
+        class_num = 203 if variant == "gld23k" else 2028
+        rng = np.random.RandomState(seed)
+        protos = rng.normal(0, 1, (class_num, image_size, image_size, 3)).astype(np.float32)
+        xtr_l, ytr_l = [], []
+        for _c in range(client_num):
+            n_i = int(np.clip(rng.lognormal(3.0, 0.6), 4, 128))
+            y_i = rng.randint(0, class_num, n_i).astype(np.int32)
+            xtr_l.append(protos[y_i] * 0.6 +
+                         rng.normal(0, 0.35, (n_i, image_size, image_size, 3)).astype(np.float32))
+            ytr_l.append(y_i)
+        yte = rng.randint(0, class_num, 64).astype(np.int32)
+        xte = protos[yte] * 0.6 + rng.normal(0, 0.35, (64, image_size, image_size, 3)).astype(np.float32)
         train = pack_client_lists(xtr_l, ytr_l)
         te_map = homo_partition(len(yte), len(xtr_l), np.random.RandomState(seed))
         return FederatedDataset(

@@ -27,12 +27,25 @@ from __future__ import annotations
 
 import gzip
 import os
+import logging
 import pickle
 import struct
 
 import numpy as np
 
+log = logging.getLogger("fedml_tpu.data")
+
 _IMG_EXTS = (".png", ".jpg", ".jpeg", ".ppm", ".bmp", ".webp")
+
+# Channel-normalization stats (single source of truth — loaders, the robust
+# backdoor main, and algorithms/backdoor.py all import these; reference
+# cifar10/data_loader.py transforms)
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.247, 0.243, 0.262], np.float32)
+CINIC10_MEAN = np.array([0.47889522, 0.47227842, 0.43047404], np.float32)
+CINIC10_STD = np.array([0.24205776, 0.23828046, 0.25874835], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -115,6 +128,17 @@ def read_image_folder(root: str, size: int | None = None,
                      if os.path.isdir(os.path.join(root, d)))
     if not classes:
         return None
+    if cap_per_class is None:
+        n_files = sum(
+            sum(1 for f in os.listdir(os.path.join(root, d))
+                if f.lower().endswith(_IMG_EXTS)) for d in classes)
+        if n_files > 200_000:  # ~30+ GB at 224px float32 — eager load is wrong
+            log.warning(
+                "read_image_folder(%s): %d images would be materialized as "
+                "host float32 (this reader is for fixture/subset-scale trees; "
+                "set cap_per_class, or use the streaming loaders — "
+                "data/streaming.py — which the ILSVRC2012/Landmarks datasets "
+                "route through)", root, n_files)
     xs, ys = [], []
     for ci, cname in enumerate(classes):
         cdir = os.path.join(root, cname)
@@ -143,8 +167,7 @@ def read_cinic10(data_dir: str, size: int = 32):
             test = read_image_folder(te, size)
             if train is None or test is None:
                 return None
-            mean = np.array([0.47889522, 0.47227842, 0.43047404], np.float32)
-            std = np.array([0.24205776, 0.23828046, 0.25874835], np.float32)
+            mean, std = CINIC10_MEAN, CINIC10_STD
             xtr, ytr, _ = train
             xte, yte, _ = test
             return ((xtr - mean) / std, ytr, (xte - mean) / std, yte)
@@ -164,8 +187,7 @@ def read_imagenet_folder(data_dir: str, size: int = 224,
     test = read_image_folder(te, size, cap_per_class)
     if train is None or test is None:
         return None
-    mean = np.array([0.485, 0.456, 0.406], np.float32)
-    std = np.array([0.229, 0.224, 0.225], np.float32)
+    mean, std = IMAGENET_MEAN, IMAGENET_STD
     xtr, ytr, classes = train
     xte, yte, _ = test
     return (xtr - mean) / std, ytr, (xte - mean) / std, yte, classes
@@ -522,8 +544,7 @@ def read_pascal_voc(data_dir: str, size: int = 64):
 
     xtr, ytr = read_split("train")
     xte, yte = read_split("val")
-    mean = np.array([0.485, 0.456, 0.406], np.float32)
-    std = np.array([0.229, 0.224, 0.225], np.float32)
+    mean, std = IMAGENET_MEAN, IMAGENET_STD
     return (xtr - mean) / std, ytr, (xte - mean) / std, yte
 
 
@@ -545,3 +566,65 @@ def read_southwest(data_dir: str):
     with open(te, "rb") as f:
         xte = np.asarray(pickle.load(f))
     return xtr.astype(np.float32) / 255.0, xte.astype(np.float32) / 255.0, 9
+
+
+def list_image_folder_files(root: str):
+    """ImageFolder tree scan WITHOUT decoding: returns (per_class_files,
+    class_names) — the streaming loaders' entry point (the eager
+    read_image_folder cannot hold ILSVRC2012-scale trees, see
+    data/streaming.py)."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        return None
+    per_class = []
+    for cname in classes:
+        cdir = os.path.join(root, cname)
+        per_class.append(sorted(
+            os.path.join(cdir, f) for f in os.listdir(cdir)
+            if f.lower().endswith(_IMG_EXTS)))
+    if not any(per_class):
+        return None
+    return per_class, classes
+
+
+def list_landmarks_files(data_dir: str, variant: str = "gld23k"):
+    """Landmarks csv scan WITHOUT decoding: returns (per_user_files,
+    per_user_labels, test_files, test_labels, class_num) or None."""
+    map_dir = os.path.join(data_dir, "data_user_dict")
+    tr_csv = os.path.join(map_dir, f"{variant}_user_dict_train.csv")
+    te_csv = os.path.join(map_dir, f"{variant}_user_dict_test.csv")
+    if not (os.path.exists(tr_csv) and os.path.exists(te_csv)):
+        return None
+    tr_rows = read_landmarks_csv(tr_csv)
+    te_rows = read_landmarks_csv(te_csv)
+
+    missing = []
+
+    def path_of(image_id):
+        p = os.path.join(data_dir, str(image_id) + ".jpg")
+        if not os.path.exists(p):
+            p = os.path.join(data_dir, "images", str(image_id) + ".jpg")
+            if not os.path.exists(p):
+                # record now: the lazy decoder would otherwise fail mid-run,
+                # hours in, where the old eager reader failed at load time
+                missing.append(str(image_id))
+        return p
+
+    by_user: dict[int, list] = {}
+    for r in tr_rows:
+        by_user.setdefault(int(r["user_id"]), []).append(r)
+    files, labels = [], []
+    for uid in sorted(by_user):
+        rows = by_user[uid]
+        files.append([path_of(r["image_id"]) for r in rows])
+        labels.append(np.asarray([int(r["class"]) for r in rows], np.int32))
+    te_files = [path_of(r["image_id"]) for r in te_rows]
+    if missing:
+        raise FileNotFoundError(
+            f"{variant}: {len(missing)} images named in the csvs are absent "
+            f"under {data_dir} (first: {missing[:3]}) — complete the download "
+            "before training (a lazy decode would fail mid-run instead)")
+    te_labels = np.asarray([int(r["class"]) for r in te_rows], np.int32)
+    class_num = int(max(max(int(la.max()) for la in labels), te_labels.max())) + 1
+    return files, labels, te_files, te_labels, class_num
