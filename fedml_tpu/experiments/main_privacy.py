@@ -30,9 +30,12 @@ def run_mi_attacks(predict_fn, trainer, variables, member, nonmember):
     """Shadow-NN + loss + gradient-norm membership attacks on the final
     model (reference privacy_fedml/MI_attack/*; privacy/mi_attack.py)."""
     from fedml_tpu.privacy.mi_attack import (
+        GradientVectorAttack,
+        MixGradientAttack,
         NNAttack,
         gradient_norm_attack,
         loss_attack,
+        make_penultimate_grad_fn,
         make_per_sample_grad_norm,
         make_per_sample_loss,
     )
@@ -50,6 +53,22 @@ def run_mi_attacks(predict_fn, trainer, variables, member, nonmember):
         gn_fn = make_per_sample_grad_norm(trainer, variables)
         out.update({f"MI/GradNorm_{k}": v for k, v in
                     gradient_norm_attack(gn_fn, (mx, my), (nx, ny)).items()})
+        pg_fn = make_penultimate_grad_fn(trainer, variables)
+
+        def local_predict(x):
+            logits, _ = trainer.apply(variables, x, train=False)
+            return logits
+
+        # gradient-vector attack: the LOCAL model's own preds + grads
+        gv = GradientVectorAttack().fit(local_predict, pg_fn, (mx, my), (nx, ny))
+        out.update({f"MI/GradVec_{k}": v for k, v in
+                    gv.score(local_predict, pg_fn, (mx, my), (nx, ny)).items()})
+        # mix-gradient attack: TARGET (ensemble) preds + LOCAL grads — the
+        # reference's feature mix (MixGradient_attack.py:104-114). Only
+        # meaningful when the target prediction differs from the local one.
+        mg = MixGradientAttack(seed=1).fit(predict_fn, pg_fn, (mx, my), (nx, ny))
+        out.update({f"MI/MixGrad_{k}": v for k, v in
+                    mg.score(predict_fn, pg_fn, (mx, my), (nx, ny)).items()})
     return out
 
 

@@ -87,7 +87,8 @@ class NNAttack:
         nprng = np.random.RandomState(self.seed)
         for e in range(self.epochs):
             order = nprng.permutation(n)
-            for s in range(0, n - self.batch_size + 1, self.batch_size):
+            # final partial batch included (n < batch_size must still train)
+            for s in range(0, n, self.batch_size):
                 i = order[s:s + self.batch_size]
                 params, st = step(params, st, x[i], y[i])
         self.variables = {"params": params}
@@ -160,3 +161,150 @@ def make_per_sample_grad_norm(trainer, variables):
         return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(g)))
 
     return jax.jit(jax.vmap(one))
+
+
+class TwoBranchAttackModel(nn.Module):
+    """Two-branch MI classifier (reference Gradient_attack.py:21-54): the
+    prediction vector and the penultimate-activation gradient run through
+    separate MLP towers (512->256->128 and 256->128) before a joint head."""
+
+    pred_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p, g = x[:, :self.pred_dim], x[:, self.pred_dim:]
+        p = nn.relu(nn.Dense(512)(p))
+        p = nn.Dropout(0.2, deterministic=not train)(p)
+        p = nn.relu(nn.Dense(256)(p))
+        p = nn.Dropout(0.2, deterministic=not train)(p)
+        p = nn.relu(nn.Dense(128)(p))
+        g = nn.relu(nn.Dense(256)(g))
+        g = nn.relu(nn.Dense(128)(g))
+        return nn.Dense(2)(jnp.concatenate([p, g], axis=1))
+
+
+def make_penultimate_grad_fn(trainer, variables, head_path: tuple | None = None):
+    """Per-sample gradient of CE wrt the classifier head's INPUT (the
+    'penultimate' activations the reference logs via model.penultimate.grad,
+    Gradient_attack.py:70): closed form (softmax - onehot) @ W_head^T, no
+    per-sample autodiff needed. `head_path` names the head module in the
+    params tree; by default the last module whose 2D kernel maps onto the
+    class dimension is used."""
+    params = variables["params"]
+    if head_path is not None:
+        node = params
+        for k in head_path:
+            node = node[k]
+        w_head_static = node["kernel"]
+    else:
+        w_head_static = None
+
+    @jax.jit
+    def f(x, y):
+        logits, _ = trainer.apply(variables, x, train=False)
+        n_classes = logits.shape[-1]
+        if w_head_static is not None:
+            w_head = w_head_static
+        else:
+            # last 2D kernel whose output width == n_classes (shapes are
+            # static under jit, so this resolves once per trace) — an
+            # embedding table or positional matrix sorting after the head
+            # must not be picked up
+            flat = jax.tree_util.tree_flatten_with_path(params)[0]
+            heads = [leaf for path, leaf in flat
+                     if path[-1].key == "kernel" and leaf.ndim == 2
+                     and leaf.shape[1] == n_classes]
+            if not heads:
+                raise ValueError(
+                    "no 2D kernel with output width == n_classes found; pass "
+                    "head_path explicitly for this model")
+            w_head = heads[-1]
+        sm = jax.nn.softmax(logits, axis=-1)
+        oh = jax.nn.one_hot(y, n_classes, dtype=sm.dtype)
+        return (sm - oh) @ w_head.T
+
+    return f
+
+
+class GradientVectorAttack:
+    """Gradient-vector-classifier MI attack (reference Gradient_attack.py:56):
+    attack features = descending-sorted softmax CONCAT penultimate-activation
+    gradient; classifier = TwoBranchAttackModel."""
+
+    def __init__(self, lr: float = 0.1, epochs: int = 40,
+                 batch_size: int = 64, seed: int = 0):
+        self.lr, self.epochs, self.batch_size, self.seed = lr, epochs, batch_size, seed
+        self.model = None
+        self.variables = None
+
+    def _features(self, pred_fn, grad_fn, x, y):
+        probs = jax.nn.softmax(pred_fn(x), axis=-1)
+        preds = jnp.sort(probs, axis=-1)[:, ::-1]          # -np.sort(-pred)
+        self._pred_dim = preds.shape[1]
+        return jnp.concatenate([preds, grad_fn(x, y)], axis=1)
+
+    def _dataset(self, pred_fn, grad_fn, member, nonmember):
+        # fit() then score() on the same arrays is the common path — reuse
+        # the features instead of re-running the model + gradient sweeps
+        key = tuple(id(a) for a in (pred_fn, grad_fn, *member, *nonmember))
+        if getattr(self, "_feat_key", None) == key:
+            return self._feat_cache
+        fm = self._features(pred_fn, grad_fn, *member)
+        fn_ = self._features(pred_fn, grad_fn, *nonmember)
+        x = jnp.concatenate([fm, fn_])
+        y = jnp.concatenate([jnp.ones(len(fm), jnp.int32),
+                             jnp.zeros(len(fn_), jnp.int32)])
+        self._feat_key, self._feat_cache = key, (x, y)
+        return x, y
+
+    def fit(self, pred_fn, grad_fn, member, nonmember):
+        x, y = self._dataset(pred_fn, grad_fn, member, nonmember)
+        self.model = TwoBranchAttackModel(pred_dim=self._pred_dim)
+        rng = jax.random.PRNGKey(self.seed)
+        v = self.model.init({"params": rng}, x[:1])
+        opt = optax.sgd(self.lr, momentum=0.9)
+        st = opt.init(v["params"])
+
+        @jax.jit
+        def step(params, st, bx, by, drng):
+            def loss(p):
+                logits = self.model.apply({"params": p}, bx, train=True,
+                                          rngs={"dropout": drng})
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, by).mean()
+
+            g = jax.grad(loss)(params)
+            upd, st2 = opt.update(g, st, params)
+            return optax.apply_updates(params, upd), st2
+
+        params, n = v["params"], len(y)
+        nprng = np.random.RandomState(self.seed)
+        dkey = jax.random.PRNGKey(self.seed + 1)
+        t = 0
+        for _ in range(self.epochs):
+            order = nprng.permutation(n)
+            # final partial batch included — tiny attack sets (< batch_size)
+            # must still train rather than silently reporting the random init
+            for s in range(0, n, self.batch_size):
+                i = order[s:s + self.batch_size]
+                params, st = step(params, st, x[i], y[i],
+                                  jax.random.fold_in(dkey, t))
+                t += 1
+        self.variables = {"params": params}
+        return self
+
+    def score(self, pred_fn, grad_fn, member, nonmember) -> dict[str, float]:
+        x, y = self._dataset(pred_fn, grad_fn, member, nonmember)
+        pred = jnp.argmax(self.model.apply(self.variables, x), -1)
+        acc = float((pred == y).mean())
+        tpr = float(pred[y == 1].mean()) if int((y == 1).sum()) else 0.0
+        fpr = float(pred[y == 0].mean()) if int((y == 0).sum()) else 0.0
+        return {"attack_acc": acc, "advantage": tpr - fpr, "tpr": tpr, "fpr": fpr}
+
+
+class MixGradientAttack(GradientVectorAttack):
+    """Mix-gradient MI attack (reference MixGradient_attack.py:104-114): the
+    prediction features come from the TARGET (global/ensemble) model while
+    the penultimate gradients come from a LOCAL branch model — fit/score take
+    (target_pred_fn, local_grad_fn). Mechanically the feature mixing IS the
+    attack; the classifier is shared with GradientVectorAttack."""
