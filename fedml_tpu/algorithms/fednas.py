@@ -25,7 +25,7 @@ import optax
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
 from fedml_tpu.models.darts import DARTSNetwork, init_alphas, parse_genotype
-from fedml_tpu.utils.pytree import tree_weighted_mean
+from fedml_tpu.utils.pytree import tree_weighted_mean, tree_where
 
 
 class NASState(NamedTuple):
@@ -37,9 +37,17 @@ class NASState(NamedTuple):
 
 def build_search_step(network: DARTSNetwork, cfg: FedConfig,
                       arch_lr: float = 3e-4, arch_wd: float = 1e-3,
-                      unrolled: bool = False, w_grad_clip: float = 5.0):
+                      unrolled: bool = False, w_grad_clip: float = 5.0,
+                      gdas: bool = False, tau: float = 5.0):
     """One DARTS search step: arch update on the val batch, then weight
     update on the train batch (reference FedNASTrainer.local_search:82).
+
+    ``gdas=True`` is the gumbel-softmax search variant (reference
+    model_search_gdas.py Network_GumbelSoftmax, tau=5 at :105): every forward
+    mixes candidate ops with a HARD straight-through gumbel sample of the
+    alphas instead of their softmax, so each step trains one sampled
+    architecture while gradients still reach all alphas through the soft
+    relaxation. ``step`` then takes a per-step rng.
 
     The weight optimizer is momentum-SGD with the learning rate applied
     *after* the momentum buffer (torch SGD semantics), taken per-step from
@@ -60,36 +68,59 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
         optax.adam(arch_lr, b1=0.5, b2=0.999),
     )
 
-    def ce(params, alphas, x, y, mask):
-        logits = network.apply({"params": params}, x, alphas[0], alphas[1], train=True)
+    def ce(params, alphas, x, y, mask, grng=None):
+        if gdas:
+            from fedml_tpu.models.darts import gumbel_softmax_st
+
+            r1, r2 = jax.random.split(grng)
+            wn = gumbel_softmax_st(r1, alphas[0], tau)
+            wr = gumbel_softmax_st(r2, alphas[1], tau)
+            logits = network.apply({"params": params}, x, alphas[0], alphas[1],
+                                   train=True, weights_normal=wn,
+                                   weights_reduce=wr)
+        else:
+            logits = network.apply({"params": params}, x, alphas[0], alphas[1],
+                                   train=True)
         per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         n = jnp.maximum(mask.sum(), 1.0)
         loss = (per * mask).sum() / n
         correct = ((jnp.argmax(logits, -1) == y) * mask).sum()
         return loss, correct
 
-    def step(state: NASState, train_batch, val_batch, lr_e):
+    def step(state: NASState, train_batch, val_batch, lr_e, val_ok=None,
+             grng=None):
         params, alphas = state.params, state.alphas
         tx, ty, tmask = train_batch
         vx, vy = val_batch
         vmask = jnp.ones(vy.shape, jnp.float32)
+        if gdas and grng is None:
+            raise ValueError("gdas=True requires a per-step rng")
+        gr_a = gr_w = None
+        if gdas:
+            gr_a, gr_w = jax.random.split(grng)
 
         # ---- architecture step (on validation data)
         if unrolled:
             def val_after_one_weight_step(alphas):
-                g = jax.grad(lambda p: ce(p, alphas, tx, ty, tmask)[0])(params)
+                g = jax.grad(lambda p: ce(p, alphas, tx, ty, tmask, gr_w)[0])(params)
                 w2 = jax.tree.map(lambda p, gg: p - lr_e * gg, params, g)
-                return ce(w2, alphas, vx, vy, vmask)[0]
+                return ce(w2, alphas, vx, vy, vmask, gr_a)[0]
 
             a_grads = jax.grad(val_after_one_weight_step)(alphas)
         else:
-            a_grads = jax.grad(lambda a: ce(params, a, vx, vy, vmask)[0])(alphas)
+            a_grads = jax.grad(lambda a: ce(params, a, vx, vy, vmask, gr_a)[0])(alphas)
         a_upd, a_opt_state = a_opt.update(a_grads, state.a_opt, alphas)
         alphas = optax.apply_updates(alphas, a_upd)
+        if val_ok is not None:
+            # a client whose local split has no val half (count < 2) draws its
+            # "val" batch from padded rows — suppress the arch step entirely
+            # rather than train alphas on padding
+            alphas = tree_where(val_ok, alphas, state.alphas)
+            a_opt_state = tree_where(val_ok, a_opt_state, state.a_opt)
 
         # ---- weight step (on training data)
         (loss, correct), w_grads = jax.value_and_grad(
-            lambda p: ce(p, alphas, tx, ty, tmask), has_aux=True
+            lambda p: ce(p, alphas, tx, ty, tmask, gr_w), has_aux=True
         )(params)
         w_upd, w_opt_state = w_opt.update(w_grads, state.w_opt, params)
         w_upd = jax.tree.map(lambda u: u * lr_e, w_upd)
@@ -107,7 +138,8 @@ class FedNASAPI:
 
     def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
                  channels: int = 8, layers: int = 4, arch_lr: float = 3e-4,
-                 unrolled: bool = False, lr_min: float = 1e-3):
+                 unrolled: bool = False, lr_min: float = 1e-3,
+                 gdas: bool = False, tau: float = 5.0):
         self.dataset = dataset
         self.cfg = cfg
         self.network = DARTSNetwork(output_dim=dataset.class_num,
@@ -117,13 +149,13 @@ class FedNASAPI:
         example = jnp.asarray(dataset.train.x[:1, 0])
         params = self.network.init({"params": rng}, example, an, ar, train=False)["params"]
         step, w_opt, a_opt = build_search_step(self.network, cfg, arch_lr=arch_lr,
-                                               unrolled=unrolled)
+                                               unrolled=unrolled, gdas=gdas,
+                                               tau=tau)
+        self.gdas = gdas
         self.global_state = NASState(params, (an, ar), w_opt.init(params),
                                      a_opt.init((an, ar)))
         self._w_opt, self._a_opt = w_opt, a_opt
         import math as _math
-
-        from fedml_tpu.utils.pytree import tree_where
 
         # cosine epoch schedule, fresh each round exactly as the reference
         # builds CosineAnnealingLR inside search() (FedNASTrainer.py:52-53):
@@ -148,10 +180,11 @@ class FedNASAPI:
             n_pad = nb * b
             count_tr = jnp.maximum(count // 2, 1)
             count_val = jnp.maximum(count - count_tr, 1)
+            val_ok = (count - count_tr) >= 1
 
             def epoch(state, ein):
                 erng, lr_e = ein
-                shuffle_rng, val_rng = jax.random.split(erng)
+                shuffle_rng, val_rng, gdas_rng = jax.random.split(erng, 3)
                 # permutation of the real train-half samples, padding last
                 # (same shuffle-inside-jit trick as engine.build_local_update)
                 u = jax.random.uniform(shuffle_rng, (n_tr_max,))
@@ -171,13 +204,15 @@ class FedNASAPI:
                 yv = jnp.take(y, vi.reshape(-1), 0).reshape((nb, b) + y.shape[1:])
 
                 def step_body(st, sin):
-                    bx, by, bm, bxv, byv = sin
+                    bx, by, bm, bxv, byv, grng = sin
                     new_st, (loss_n, correct, n) = step(
-                        st, (bx, by, bm), (bxv, byv), lr_e)
+                        st, (bx, by, bm), (bxv, byv), lr_e, val_ok, grng)
                     st = tree_where(n > 0, new_st, st)
                     return st, (loss_n, correct, n)
 
-                state, ms = jax.lax.scan(step_body, state, (xe, ye, bvalid, xv, yv))
+                state, ms = jax.lax.scan(
+                    step_body, state,
+                    (xe, ye, bvalid, xv, yv, jax.random.split(gdas_rng, nb)))
                 return state, tuple(m.sum() for m in ms)
 
             state, (loss_n, correct, n) = jax.lax.scan(

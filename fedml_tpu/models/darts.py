@@ -208,9 +208,15 @@ class DARTSNetwork(nn.Module):
         return sum(2 + i for i in range(self.steps))
 
     @nn.compact
-    def __call__(self, x, alphas_normal, alphas_reduce, train: bool = False):
-        wn = nn.softmax(alphas_normal, axis=-1)
-        wr = nn.softmax(alphas_reduce, axis=-1)
+    def __call__(self, x, alphas_normal, alphas_reduce, train: bool = False,
+                 weights_normal=None, weights_reduce=None):
+        # precomputed mixing weights override the softmax (the GDAS variant
+        # passes straight-through gumbel-softmax samples — reference
+        # model_search_gdas.py:122-129 Network_GumbelSoftmax.forward)
+        wn = (weights_normal if weights_normal is not None
+              else nn.softmax(alphas_normal, axis=-1))
+        wr = (weights_reduce if weights_reduce is not None
+              else nn.softmax(alphas_reduce, axis=-1))
         c_curr = self.stem_multiplier * self.channels
         s = nn.Conv(c_curr, (3, 3), padding=1, use_bias=False, name="stem")(x)
         s0 = s1 = _bn(s)
@@ -227,6 +233,21 @@ class DARTSNetwork(nn.Module):
             reduction_prev = reduction
         out = jnp.mean(s1, axis=(1, 2))
         return nn.Dense(self.output_dim, name="classifier")(out)
+
+
+def gumbel_softmax_st(rng, alphas, tau: float = 5.0):
+    """Hard straight-through gumbel-softmax over the primitive axis —
+    torch F.gumbel_softmax(alphas, tau, hard=True) semantics (reference
+    model_search_gdas.py:127-129): forward = one-hot of the perturbed argmax,
+    backward = soft sample's gradient."""
+    import jax
+
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rng, alphas.shape, minval=1e-10, maxval=1.0) + 1e-10))
+    soft = nn.softmax((alphas + g) / tau, axis=-1)
+    hard = jax.nn.one_hot(jnp.argmax(soft, axis=-1), alphas.shape[-1],
+                          dtype=soft.dtype)
+    return hard + soft - jax.lax.stop_gradient(soft)
 
 
 def init_alphas(rng, steps: int = 4, scale: float = 1e-3):

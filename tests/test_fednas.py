@@ -155,3 +155,69 @@ def test_fednas_sweep_counts_ragged_clients():
     api = FedNASAPI(ds, cfg, channels=4, layers=2)
     rec = api.train_one_round(0)
     assert rec["search_samples"] == cfg.epochs * sum(int(c) // 2 for c in counts)
+
+
+def test_fednas_arch_step_skipped_without_val_half():
+    """A count==1 client has no validation half; its 'val' batch would be
+    padding. The arch step must be a no-op there (ADVICE r2): a federation of
+    only count==1 clients leaves alphas exactly at their init."""
+    from fedml_tpu.algorithms.fednas import FedNASAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.packing import PackedClients
+    from fedml_tpu.data.registry import FederatedDataset
+
+    rng = np.random.RandomState(1)
+    C, n_max = 2, 8
+    counts = np.array([1, 1], np.int32)
+    x = rng.rand(C, n_max, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 4, size=(C, n_max)).astype(np.int32)
+    packed = PackedClients(x, y, counts)
+    ds = FederatedDataset(name="tiny", train=packed, test=packed,
+                          train_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          test_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          class_num=4)
+    cfg = FedConfig(comm_round=1, epochs=2, batch_size=4, lr=0.05,
+                    client_num_in_total=C, client_num_per_round=C)
+    api = FedNASAPI(ds, cfg, channels=4, layers=2)
+    a0 = tuple(np.asarray(a) for a in api.global_state.alphas)
+    p0 = jax.tree.leaves(api.global_state.params)[0].copy()
+    api.train_one_round(0)
+    a1 = api.global_state.alphas
+    np.testing.assert_array_equal(a0[0], np.asarray(a1[0]))
+    np.testing.assert_array_equal(a0[1], np.asarray(a1[1]))
+    # ...while the weight step still trains on the single real sample
+    p1 = jax.tree.leaves(api.global_state.params)[0]
+    assert float(jnp.max(jnp.abs(p1 - p0))) > 0.0
+
+
+@pytest.mark.slow
+def test_gdas_search_improves_and_parses_genotype():
+    """GDAS variant (reference model_search_gdas.py): gumbel-softmax hard
+    sampling over the DARTS space — search loss improves on toy data and the
+    final alphas parse to a genotype."""
+    from fedml_tpu.algorithms.fednas import FedNASAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.packing import PackedClients
+    from fedml_tpu.data.registry import FederatedDataset
+    from fedml_tpu.models.darts import Genotype
+
+    rng = np.random.RandomState(3)
+    C, n = 2, 12
+    x = rng.rand(C, n, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 4, size=(C, n)).astype(np.int32)
+    packed = PackedClients(x, y, np.full(C, n, np.int32))
+    ds = FederatedDataset(name="tiny", train=packed, test=packed,
+                          train_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          test_global=(x.reshape(-1, 8, 8, 3), y.reshape(-1)),
+                          class_num=4)
+    cfg = FedConfig(comm_round=2, epochs=2, batch_size=6, lr=0.1,
+                    client_num_in_total=C, client_num_per_round=C)
+    api = FedNASAPI(ds, cfg, channels=4, layers=2, gdas=True, tau=5.0)
+    a0 = np.asarray(api.global_state.alphas[0]).copy()
+    r0 = api.train_one_round(0)
+    r1 = api.train_one_round(1)
+    assert np.isfinite(r0["search_loss"]) and np.isfinite(r1["search_loss"])
+    # alphas moved through the straight-through estimator
+    assert float(jnp.max(jnp.abs(np.asarray(api.global_state.alphas[0]) - a0))) > 1e-7
+    assert isinstance(api.genotype_history[-1], Genotype)
+    assert r1["search_loss"] < r0["search_loss"] * 1.5  # trains, not diverging
