@@ -55,8 +55,8 @@ def poison_client_data(x: np.ndarray, y: np.ndarray, count: int,
     return x, y
 
 
-CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
-CIFAR10_STD = np.array([0.247, 0.243, 0.262], np.float32)
+from fedml_tpu.data.readers import CIFAR10_MEAN, CIFAR10_STD  # noqa: E402
+# (single source of truth for channel stats lives in data/readers.py)
 
 
 def load_edge_case_sets(data_dir: str = "./data", normalize=True):

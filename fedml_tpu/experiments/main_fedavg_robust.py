@@ -44,10 +44,29 @@ def main(argv=None):
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
 
     # ---- poison the attackers' packed rows (reference load_poisoned_dataset)
+    # Normalize the edge-case images with the SAME channel stats the target
+    # dataset's loader applied (keyed by dataset name, not image shape —
+    # cinic10 is 32x32x3 but uses CINIC stats, data/readers.py:146-148)
+    from fedml_tpu.data.readers import CINIC10_MEAN, CINIC10_STD
+
+    _edge_stats = {
+        "cifar10": True,  # load_edge_case_sets' default CIFAR-10 stats
+        "cifar100": True,  # load_cifar_arrays normalizes cifar100 identically
+        "cinic10": (CINIC10_MEAN, CINIC10_STD),
+    }
     edge = None
-    img_shape = ds.train.x.shape[2:]
-    if img_shape == (32, 32, 3):  # edge-case sets are CIFAR-shaped
-        edge = load_edge_case_sets(args.data_dir)
+    if args.dataset in _edge_stats and tuple(ds.train.x.shape[2:]) == (32, 32, 3):
+        edge = load_edge_case_sets(args.data_dir,
+                                   normalize=_edge_stats[args.dataset])
+    if args.attacker_num > 0 and not isinstance(ds.train.x, np.ndarray):
+        # streaming datasets (ILSVRC2012/gld*) expose a lazy x facade with no
+        # item assignment — poisoning mutates rows, so materialize (bounded
+        # by the stream byte budget; errors clearly past it)
+        from dataclasses import replace as _dc_replace
+
+        from fedml_tpu.data.streaming import materialize
+
+        ds = _dc_replace(ds, train=materialize(ds.train))
     rng = np.random.RandomState(cfg.seed)
     for k in range(min(args.attacker_num, ds.train.num_clients)):
         count = int(ds.train.counts[k])

@@ -2,10 +2,12 @@
 model family the LSTM zoo (reference rnn.py) caps at 20-80 token windows.
 
 Uses the pallas flash-attention kernel (fedml_tpu/ops/attention.py) as the
-hot op: O(T) memory in the forward, so client windows can grow far past the
-reference's limits; across chips the same blocks compose with
-`fedml_tpu.parallel.sequence.ring_attention` (sequence sharded over a mesh
-axis). Pre-norm blocks, learned positional embeddings, per-position logits
+hot op. NB the O(T) memory win applies to the FORWARD (inference / eval):
+long inference windows run far past what a dense score matrix allows, but
+the kernel's backward currently recomputes through the dense jnp reference,
+so *training* memory is still O(T^2) per block — long-context training
+relies on sequence parallelism (`fedml_tpu.parallel.sequence.ring_attention`,
+sequence sharded over a mesh axis) rather than the kernel alone. Pre-norm blocks, learned positional embeddings, per-position logits
 (NWPTrainer-compatible, like RNN_StackOverFlow)."""
 
 from __future__ import annotations

@@ -13,9 +13,10 @@ Design (flash-attention-1 style, /opt/skills/guides/pallas_guide.md):
   matrix never materializes.
 - Q/K/V blocks are MXU-shaped (block 128 on sequence, full head dim lanes).
 - training: `flash_attention` is a jax.custom_vjp whose backward recomputes
-  through the jnp reference (standard recompute strategy — the memory win
-  in the forward is what long-context needs; XLA differentiates the
-  reference efficiently).
+  through the *dense* jnp reference — the backward therefore materializes
+  the [B, H, T, T] score matrix, so the O(T) memory claim holds for the
+  forward/inference only. Training at long T should shard the sequence
+  (parallel/sequence.py ring attention) or await a blocked flash backward.
 - off-TPU (tests, CPU CI) the kernel runs in pallas interpret mode.
 """
 
