@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import random
 
 import numpy as np
@@ -71,6 +72,11 @@ def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
         level=logging.INFO,
         format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
     )
+    # persistent XLA compile cache (repo-local, gitignored): repeat CLI runs
+    # of compile-heavy mains (DARTS/GDAS especially) skip recompilation
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
     random.seed(args.seed)
     np.random.seed(args.seed)
     cfg = config_from_args(args)

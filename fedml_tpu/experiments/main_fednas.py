@@ -15,11 +15,16 @@ def main(argv=None):
     parser.add_argument("--layers", type=int, default=4)
     parser.add_argument("--arch_lr", type=float, default=3e-4)
     parser.add_argument("--unrolled", type=int, default=0)
+    # GDAS variant (reference model_search_gdas.py): hard gumbel-softmax
+    # architecture sampling with temperature tau
+    parser.add_argument("--gdas", type=int, default=0)
+    parser.add_argument("--tau", type=float, default=5.0)
     args = parser.parse_args(argv)
     cfg, ds, _ = setup_run(args)
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
     api = FedNASAPI(ds, cfg, channels=args.init_channels, layers=args.layers,
-                    arch_lr=args.arch_lr, unrolled=bool(args.unrolled))
+                    arch_lr=args.arch_lr, unrolled=bool(args.unrolled),
+                    gdas=bool(args.gdas), tau=args.tau)
     history = api.train()
     for rec in history:
         logger.log({"search_loss": rec["search_loss"]}, step=rec["round"])

