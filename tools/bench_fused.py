@@ -1,0 +1,106 @@
+"""Measure the fused local-SGD kernel vs the engine path on the real chip.
+
+Flagship config (CNN_DropOut 62-way, 10 clients x 200 samples, bs 20, E=1,
+SGD lr .1 clip 1.0, bf16) — the bench.py workload. Prints ms/round for both
+paths and the fused/engine speedup, plus a numeric cross-check of one
+dropout-free round (compiled TPU kernel vs engine) to guard against Mosaic
+miscompilation at the real shapes.
+"""
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_multi_round_fn, build_round_fn
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+    from fedml_tpu.ops.fused_sgd import (
+        FusedEpochSpec, build_fused_round_fn, build_fused_multi_round_fn)
+
+    cfg = FedConfig(batch_size=20, epochs=1, lr=0.1, client_optimizer="sgd",
+                    client_num_per_round=10, dtype="bfloat16")
+    trainer = ClassificationTrainer(create_model("cnn", output_dim=62, dtype="bfloat16"))
+    agg = make_aggregator("fedavg", cfg)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(10, 200, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 62, size=(10, 200)).astype(np.int32))
+    counts = jnp.asarray(np.full(10, 200, np.int32))
+    key = jax.random.PRNGKey(0)
+    gv = trainer.init(key, x[0, :1])
+    state = agg.init_state(gv)
+
+    def readback(tree):
+        leaf = jax.tree.leaves(tree)[0]
+        return float(jnp.asarray(leaf).ravel()[0])
+
+    # ---- numeric cross-check: dropout/shuffle off, f32, one round ---------
+    spec_chk = FusedEpochSpec(drop1=0.0, drop2=0.0, compute_dtype=jnp.float32)
+    cfg_chk = cfg.replace(shuffle=False, dtype="float32")
+    fused_chk = build_fused_round_fn(spec_chk, agg, shuffle=False)
+    # engine with train-mode dropout disabled is not expressible through the
+    # stock CNN_DropOut module; eval-mode forward == dropout-free forward, so
+    # cross-check gradients via the no-drop twin the tests use
+    import flax.linen as nn
+
+    class _CNNNoDrop(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv2d_1")(x))
+            x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2d_2")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(128, name="linear_1")(x))
+            return nn.Dense(62, name="linear_2")(x).astype(jnp.float32)
+
+    tr_twin = ClassificationTrainer(_CNNNoDrop())
+    gv32 = tr_twin.init(jax.random.PRNGKey(0), x[0, :1])
+    engine_chk = build_round_fn(tr_twin, cfg_chk, agg)
+    g_e, _, m_e = engine_chk(gv32, agg.init_state(gv32), x, y, counts, key)
+    g_f, _, m_f = fused_chk(gv32, agg.init_state(gv32), x, y, counts, key)
+    errs = [float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(jax.tree.leaves(g_e), jax.tree.leaves(g_f))]
+    print(f"numeric check (f32, no dropout): max abs param diff = {max(errs):.3e}")
+    print(f"  engine metrics {jax.tree.map(float, m_e)}")
+    print(f"  fused  metrics {jax.tree.map(float, m_f)}")
+
+    # ---- timing -----------------------------------------------------------
+    scan_rounds, reps = 20, 3
+    engine_multi = build_multi_round_fn(trainer, cfg, agg, scan_rounds)
+    spec = FusedEpochSpec()  # bf16, dropout on — the real flagship
+    fused_multi = build_fused_multi_round_fn(spec, agg, scan_rounds)
+
+    results = {}
+    for name, fn in [("engine", engine_multi), ("fused", fused_multi)]:
+        g, s, _ = fn(gv, state, x, y, counts, key)  # compile
+        readback(g)
+        best = float("inf")
+        for rep in range(reps):
+            g2, s2 = gv, state
+            t0 = time.perf_counter()
+            for r in range(3):
+                g2, s2, _ = fn(g2, s2, x, y, counts, jax.random.fold_in(key, r))
+            readback(g2)
+            best = min(best, time.perf_counter() - t0)
+        ms_round = best * 1e3 / (3 * scan_rounds)
+        results[name] = ms_round
+        sps = 10 * 200 / (ms_round / 1e3)
+        print(f"{name}: {ms_round:.3f} ms/round  ({sps:,.0f} samples/s/chip)")
+        # loss sanity at the end of the measured trajectory
+        print(f"  final-loss finite: {np.isfinite(readback(g2))}")
+
+    print(f"fused speedup vs engine: {results['engine'] / results['fused']:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Compile + run the bf16 fused kernel alone (compile-time probe)."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.cache import enable_compile_cache
+enable_compile_cache()
+from fedml_tpu.ops.fused_sgd import FusedEpochSpec, fused_epoch
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.models.registry import create_model
+
+spec = FusedEpochSpec()  # bf16 flagship
+trainer = ClassificationTrainer(create_model("cnn", output_dim=62, dtype="bfloat16"))
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.rand(10, 200, 28, 28, 1).astype(np.float32))
+y = jnp.asarray(rng.randint(0, 62, size=(10, 200)).astype(np.int32))
+gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
+seeds = jnp.arange(10, dtype=jnp.int32)
+f = jax.jit(lambda gv, x, y, s: fused_epoch(spec, gv, x, y, s))
+t0 = time.perf_counter()
+print("lowering...", flush=True)
+lowered = f.lower(gv, x, y, seeds)
+print(f"lowered in {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+comp = lowered.compile()
+print(f"compiled in {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+out, met = comp(gv, x, y, seeds)
+jax.block_until_ready(out)
+print(f"ran in {time.perf_counter()-t0:.3f}s", flush=True)
+print("metrics:", {k: np.asarray(v)[:3] for k, v in met.items()}, flush=True)
