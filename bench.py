@@ -101,9 +101,10 @@ def main():
         if (fused and workload == "flagship" and epochs == 1
                 and n_per_client % batch_size == 0):
             # fused local-SGD pallas kernel (ops/fused_sgd.py): the whole
-            # client epoch in one program, weights resident in VMEM.
-            # Measured ~2x the engine path (docs/PERF.md); falls back to the
-            # engine path on any compile/runtime error.
+            # client epoch in one program, weights resident in VMEM. Measured
+            # SLOWER than the engine path at flagship shapes (0.44x — see
+            # docs/PERF.md for why), kept opt-in as the measured experiment;
+            # falls back to the engine path on any compile/runtime error.
             try:
                 from fedml_tpu.ops.fused_sgd import (
                     FusedEpochSpec, build_fused_multi_round_fn)
