@@ -12,11 +12,13 @@ Design (flash-attention-1 style, /opt/skills/guides/pallas_guide.md):
   f32 scratch — the online-softmax recurrence, so the full [T, T] score
   matrix never materializes.
 - Q/K/V blocks are MXU-shaped (block 128 on sequence, full head dim lanes).
-- training: `flash_attention` is a jax.custom_vjp whose backward recomputes
-  through the *dense* jnp reference — the backward therefore materializes
-  the [B, H, T, T] score matrix, so the O(T) memory claim holds for the
-  forward/inference only. Training at long T should shard the sequence
-  (parallel/sequence.py ring attention) or await a blocked flash backward.
+- training: `flash_attention` is a jax.custom_vjp with a BLOCKED backward
+  (FlashAttention-2 style): the forward also emits the per-row logsumexp,
+  and two streaming kernels recompute p block-by-block — dQ sweeping K
+  blocks, dK/dV sweeping Q blocks — so no [T, T] score matrix ever
+  materializes in either direction and the O(T) memory claim holds for
+  training too. `parallel/sequence.py` ring attention composes the same
+  recurrence across chips.
 - off-TPU (tests, CPU CI) the kernel runs in pallas interpret mode.
 """
 
@@ -27,6 +29,30 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _block_live(qi, ki, q_block, k_block, causal):
+    """Whether a (q-block, k-block) tile has any unmasked entries."""
+    return (ki * k_block <= (qi + 1) * q_block - 1) if causal else (ki >= 0)
+
+
+def _masked_scores(qb, kb, qi, ki, q_block, k_block, scale, causal, precision):
+    """Scaled (and causally masked) score tile s = (q*scale) @ k^T — the
+    single definition shared by the forward and both backward kernels so
+    masking/scaling can never desynchronize between them."""
+    s = jax.lax.dot(qb.astype(jnp.float32) * scale,
+                    kb.astype(jnp.float32).T, precision=precision)
+    if causal:
+        q_idx = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_idx = ki * k_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+    return s
 
 
 def attention_reference(q, k, v, causal: bool = False):
@@ -41,8 +67,9 @@ def attention_reference(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
-                      causal, n_kb, q_block, k_block, scale, precision):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr,
+                      l_scr, *, causal, n_kb, q_block, k_block, scale,
+                      precision):
     """Grid (batch*head, q_blocks, k_blocks): TPU iterates the last grid dim
     sequentially, so the f32 scratch accumulators (numerator O, running max
     M, denominator L) persist across the K-block sweep — K/V truly stream
@@ -59,18 +86,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
 
     # causal: K blocks strictly after this Q block's last row are all masked
-    live = (ki * k_block <= (qi + 1) * q_block - 1) if causal else (ki >= 0)
+    live = _block_live(qi, ki, q_block, k_block, causal)
 
     @pl.when(live)
     def _block():
-        qb = q_ref[:].astype(jnp.float32) * scale   # [block_q, D]
-        kb = k_ref[:]                                # [block_k, D]
         vb = v_ref[:]
-        s = jax.lax.dot(qb, kb.astype(jnp.float32).T, precision=precision)
-        if causal:
-            q_idx = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = ki * k_block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+        s = _masked_scores(q_ref[:], k_ref[:], qi, ki, q_block, k_block,
+                           scale, causal, precision)
         m = m_scr[:]
         m_new = jnp.maximum(m, s.max(axis=-1))
         # exp(-inf - -inf) guard: rows with no valid keys yet keep m=-inf
@@ -85,10 +107,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
     def _finalize():
         o_ref[:] = (o_scr[:] / jnp.maximum(l_scr[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
+        # per-row logsumexp of the scaled scores — the blocked backward's
+        # residual (p is recomputed as exp(s - lse))
+        lse_ref[:] = (m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30)))[:, None]
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
+               interpret: bool, return_lse: bool = False):
     from jax.experimental import pallas as pl
 
     b, tq, h, d = q.shape
@@ -114,7 +139,7 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         scale=1.0 / np.sqrt(d), precision=precision)
     from jax.experimental.pallas import tpu as pltpu
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q, n_kb),
         in_specs=[
@@ -122,8 +147,16 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((None, block_k, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda g, i, j: (g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i, j: (g, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i, j: (g, i, 0)),
+            # trailing unit lane dim: Mosaic requires the block's last two
+            # dims be (8,128)-divisible or equal to the array's
+            pl.BlockSpec((None, block_q, 1), lambda g, i, j: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -131,31 +164,181 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    out4 = out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out4, lse[..., 0]
+    return out4
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128, interpret: bool | None = None):
-    """Flash attention, pallas forward. q/k/v: [B, T, H, D].
+    """Flash attention, pallas kernels both ways. q/k/v: [B, T, H, D].
 
     `interpret=None` auto-selects: compiled on TPU, interpret mode elsewhere
-    (the CPU CI path). Backward recomputes through attention_reference."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    (the CPU CI path). The backward is BLOCKED too (p recomputed per tile
+    from the saved logsumexp) — O(T) memory for training as well."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k,
+                      _resolve_interpret(interpret))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                         dq_ref, dq_scr, *, causal, n_kb, q_block, k_block,
+                         scale, precision):
+    """Grid (batch*head, q_blocks, k_blocks): sweeps K blocks, accumulating
+    this Q block's gradient in f32 scratch. p is recomputed from the saved
+    logsumexp, so only [block_q, block_k] tiles ever exist."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = _block_live(qi, ki, q_block, k_block, causal)
+
+    @pl.when(live)
+    def _block():
+        kb = k_ref[:].astype(jnp.float32)
+        vb = v_ref[:].astype(jnp.float32)
+        dob = do_ref[:].astype(jnp.float32)
+        s = _masked_scores(q_ref[:], k_ref[:], qi, ki, q_block, k_block,
+                           scale, causal, precision)
+        p = jnp.exp(s - lse_ref[...])                     # [bq, bk] via [bq,1]
+        dp = jax.lax.dot(dob, vb.T, precision=precision)  # [bq, bk]
+        ds = p * (dp - dl_ref[...])
+        dq_scr[:] = dq_scr[:] + jax.lax.dot(ds, kb, precision=precision) * scale
+
+    @pl.when(ki == n_kb - 1)
+    def _done():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal, n_qb,
+                          q_block, k_block, scale, precision):
+    """Grid (batch*head, k_blocks, q_blocks): sweeps Q blocks, accumulating
+    this K block's dK and dV in f32 scratch."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = _block_live(qi, ki, q_block, k_block, causal)
+
+    @pl.when(live)
+    def _block():
+        qb = q_ref[:].astype(jnp.float32)
+        vb = v_ref[:].astype(jnp.float32)
+        dob = do_ref[:].astype(jnp.float32)
+        s = _masked_scores(q_ref[:], k_ref[:], qi, ki, q_block, k_block,
+                           scale, causal, precision)
+        p = jnp.exp(s - lse_ref[...])                     # [bq, bk] via [bq,1]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())), precision=precision)
+        dp = jax.lax.dot(dob, vb.T, precision=precision)
+        ds = p * (dp - dl_ref[...])
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())), precision=precision) * scale
+
+    @pl.when(qi == n_qb - 1)
+    def _done():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Blocked backward: dq/dk/dv without materializing [T, T]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    orr = out.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    gr = g.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    # delta_i = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    # lse/delta ride as [B*H, Tq, 1] (unit lane dim for Mosaic block rules)
+    delta = jnp.sum(gr.astype(jnp.float32) * orr.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    lse3 = lse[..., None]
+    precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    scale = 1.0 / np.sqrt(d)
+    n_qb, n_kb = tq // block_q, tk // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, n_kb=n_kb,
+                          q_block=block_q, k_block=block_k, scale=scale,
+                          precision=precision),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g_, i, j: (g_, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g_, i, j: (g_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g_, i, j: (g_, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda g_, i, j: (g_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda g_, i, j: (g_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda g_, i, j: (g_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda g_, i, j: (g_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, n_qb=n_qb,
+                          q_block=block_q, k_block=block_k, scale=scale,
+                          precision=precision),
+        grid=(b * h, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g_, j, i: (g_, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g_, j, i: (g_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g_, j, i: (g_, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda g_, j, i: (g_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda g_, j, i: (g_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda g_, j, i: (g_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda g_, j, i: (g_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g_, j, i: (g_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse3, delta)
+
+    def back4(t, tlen):
+        return t.reshape(b, h, tlen, d).transpose(0, 2, 1, 3)
+
+    return back4(dq, tq), back4(dk, tk), back4(dv, tk)
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+                          _resolve_interpret(interpret), return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                      _resolve_interpret(interpret))
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

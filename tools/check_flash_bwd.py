@@ -1,0 +1,59 @@
+"""Validate + measure the blocked flash backward on the real chip.
+
+1) compiled-vs-dense-autodiff gradient check at multi-block shapes;
+2) the memory claim: a causal T=8192 TRAINING step (fwd+bwd through the
+   kernel) runs on-chip, where dense autodiff would materialize
+   [B,H,T,T] (~268 MB f32 per (b,h) pair, several such buffers live at
+   once in the backward) and OOM.
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.cache import enable_compile_cache
+
+enable_compile_cache()
+from fedml_tpu.ops.attention import attention_reference, flash_attention  # noqa: E402
+
+
+def main():
+    rng = np.random.RandomState(0)
+    b, t, h, d = 2, 512, 4, 64
+    q, k, v, cot = (jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+                    for _ in range(4))
+
+    for causal in (False, True):
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal, 128, 128) * cot), (0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal) * cot), (0, 1, 2))(q, k, v)
+        errs = [float(jnp.max(jnp.abs(a - b2))) for a, b2 in zip(gf, gd)]
+        print(f"causal={causal}: max |dq,dk,dv| diff vs dense autodiff = "
+              f"{[f'{e:.2e}' for e in errs]}")
+
+    # long-context training step: T=8192 causal, bf16
+    b2_, t2, h2, d2 = 1, 8192, 4, 128
+    x = jnp.asarray(rng.normal(size=(b2_, t2, h2, d2)).astype(np.float32)).astype(jnp.bfloat16)
+
+    @jax.jit
+    def train_loss(x):
+        o = flash_attention(x, x, x, True, 128, 128)
+        return jnp.mean(o.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(train_loss))
+    r = g(x)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = g(x)
+    jax.block_until_ready(r)
+    float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+    dt = time.perf_counter() - t0
+    print(f"T=8192 causal bf16 fwd+bwd step: OK in {dt*1e3:.0f} ms "
+          f"(dense would need ~{t2*t2*4/1e9:.1f} GB per (b,h) score matrix)")
+
+
+if __name__ == "__main__":
+    main()
