@@ -367,3 +367,83 @@ def test_fednova_parity():
     )
     # reference tau_i = epochs * ceil(count/bs): [4, 6, 4, 6]
     _assert_match(ref_sd, new_global)
+
+
+def test_lda_partitioner_exact_parity():
+    """(e) The LDA partitioner consumes the SAME numpy rng call sequence as
+    the reference (shuffle class indices -> dirichlet -> quota-zeroing ->
+    cumsum cuts -> final per-client shuffle), so with an identical seed the
+    net_dataidx_map must be IDENTICAL, index for index."""
+    from fedml_core.non_iid_partition.noniid_partition import (
+        non_iid_partition_with_dirichlet_distribution as ref_lda,
+    )
+
+    from fedml_tpu.core.partition import (
+        non_iid_partition_with_dirichlet_distribution as our_lda,
+    )
+
+    y = np.random.RandomState(123).randint(0, 6, size=400)
+    np.random.seed(42)
+    ref_map = ref_lda(y, client_num=7, classes=6, alpha=0.5)
+    our_map = our_lda(y, client_num=7, classes=6, alpha=0.5,
+                      rng=np.random.RandomState(42))
+    assert set(ref_map) == set(our_map)
+    for k in ref_map:
+        np.testing.assert_array_equal(np.asarray(ref_map[k]),
+                                      np.asarray(our_map[k]),
+                                      err_msg=f"client {k} differs")
+
+
+def test_robust_clip_parity():
+    """(f) Norm-diff clipping vs the reference RobustAggregator
+    (fedml_core/robustness/robust_aggregation.py:38-49): same deltas in,
+    same clipped weights out (stddev=0 isolates the deterministic clip).
+
+    NB two latent defects in the reference (worked around, not replicated):
+    vectorize_weight torch.cat's UNFLATTENED params (crashes for any model
+    mixing 2D weights with 1D biases), and load_model_weight_diff calls
+    .state_dict() on what its one call site passes as a plain state dict
+    (FedAvgRobustAggregator.py:180-182). The test drives the reference lines
+    with a single-tensor model via a shim exposing both surfaces; the rebuild
+    clips the full pytree correctly (norm over ALL weight leaves)."""
+    from fedml_core.robustness.robust_aggregation import (
+        RobustAggregator as RefRobust,
+    )
+
+    from fedml_tpu.algorithms.aggregators import RobustAggregator
+    from fedml_tpu.algorithms.engine import LocalResult
+
+    rng = np.random.RandomState(0)
+    gw = rng.normal(size=(D, C)).astype(np.float32)
+    # two clients: one small delta (unclipped), one huge (clipped)
+    deltas = [0.1 * rng.normal(size=(D, C)).astype(np.float32),
+              50.0 * rng.normal(size=(D, C)).astype(np.float32)]
+    bound = 2.0
+
+    class _SdShim(dict):
+        def state_dict(self):
+            return self
+
+    ref_agg = RefRobust(SimpleNamespace(defense_type="norm_diff_clipping",
+                                        norm_bound=bound, stddev=0.0))
+    g_sd = {"w": torch.tensor(gw)}
+    ref_clipped = []
+    for dlt in deltas:
+        local_sd = _SdShim(w=torch.tensor(gw + dlt))
+        out = ref_agg.norm_diff_clipping(local_sd, g_sd)
+        ref_clipped.append(out["w"].numpy())
+    ref_avg_w = np.mean(ref_clipped, axis=0)
+
+    cfg = FedConfig(norm_bound=bound, stddev=0.0)
+    agg = RobustAggregator(cfg)
+    gv = {"params": {"dense": {"kernel": jnp.asarray(gw)}}}
+    stacked = {"params": {"dense": {
+        "kernel": jnp.stack([jnp.asarray(gw + d) for d in deltas]),
+    }}}
+    result = LocalResult(variables=stacked,
+                         num_steps=jnp.ones(2, jnp.int32),
+                         metrics={})
+    avg, _ = agg(gv, result, jnp.ones(2, jnp.float32),
+                 jax.random.PRNGKey(0), ())
+    np.testing.assert_allclose(np.asarray(avg["params"]["dense"]["kernel"]),
+                               ref_avg_w, rtol=1e-5, atol=1e-6)
