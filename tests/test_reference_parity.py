@@ -447,3 +447,32 @@ def test_robust_clip_parity():
                  jax.random.PRNGKey(0), ())
     np.testing.assert_allclose(np.asarray(avg["params"]["dense"]["kernel"]),
                                ref_avg_w, rtol=1e-5, atol=1e-6)
+
+
+def test_symmetric_topology_exact_parity():
+    """(g) Decentralized mixing matrices vs the living reference
+    (symmetric_topology_manager.py:21-52): Watts-Strogatz at rewire p=0 is a
+    deterministic ring lattice, so the row-stochastic mixing matrix must
+    match EXACTLY for several (n, neighbor_num) shapes."""
+    nx = pytest.importorskip("networkx")  # the reference's dependency
+    if not hasattr(nx, "to_numpy_matrix"):
+        # networkx >= 3 removed to_numpy_matrix; same values via
+        # to_numpy_array (API-compat shim so the 2020-era reference runs)
+        nx.to_numpy_matrix = nx.to_numpy_array
+    from fedml_core.distributed.topology.symmetric_topology_manager import (
+        SymmetricTopologyManager as RefSym,
+    )
+
+    from fedml_tpu.core.topology import SymmetricTopologyManager
+
+    for n, k in [(6, 2), (8, 4), (10, 2), (9, 4)]:
+        ref = RefSym(n, neighbor_num=k)
+        ref.generate_topology()
+        ours = SymmetricTopologyManager(n, neighbor_num=k)
+        ours.generate_topology()
+        np.testing.assert_allclose(
+            np.asarray(ours.topology), np.asarray(ref.topology),
+            rtol=0, atol=1e-7, err_msg=f"(n={n}, k={k})")
+        for node in range(n):
+            assert (ours.get_in_neighbor_idx_list(node)
+                    == ref.get_in_neighbor_idx_list(node)), (n, k, node)
