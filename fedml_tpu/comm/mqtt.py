@@ -176,14 +176,22 @@ class MiniBroker:
 
 
 class MqttClient:
-    """Minimal MQTT 3.1.1 client: connect, subscribe(topic, cb), publish."""
+    """Minimal MQTT 3.1.1 client: connect, subscribe(topic, cb), publish.
 
-    def __init__(self, host: str, port: int, client_id: str):
-        self._sock = socket.create_connection((host, port), timeout=30)
-        self._sock.sendall(_connect_packet(client_id))
-        head, body = _read_packet(self._sock)
-        if head & 0xF0 != CONNACK or body[1] != 0:
-            raise ConnectionError(f"MQTT CONNACK refused: {body!r}")
+    paho-parity semantics the reference gets from its client library:
+    a keepalive PINGREQ loop, and automatic reconnect + re-subscribe after
+    a dropped connection (QoS-0: messages published while disconnected are
+    lost, exactly as with paho at QoS 0)."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 keepalive: float = 60.0, reconnect: bool = True,
+                 reconnect_backoff: float = 0.2, reconnect_tries: int = 5):
+        self._addr = (host, port)
+        self._client_id = client_id
+        self._keepalive = keepalive
+        self._reconnect = reconnect
+        self._backoff = reconnect_backoff
+        self._tries = reconnect_tries
         self._cbs: dict[str, Callable[[str, bytes], None]] = {}
         self._pid = 0
         self._send_lock = threading.Lock()  # publish/subscribe from any thread
@@ -191,27 +199,76 @@ class MqttClient:
         # subscribers never return on each other's ack
         self._pending_subacks: dict[int, threading.Event] = {}
         self._stop = threading.Event()
+        self._sock = self._connect()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        self._ping_thread = threading.Thread(target=self._ping_loop, daemon=True)
+        self._ping_thread.start()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=30)
+        sock.sendall(_connect_packet(self._client_id))
+        head, body = _read_packet(sock)
+        if head & 0xF0 != CONNACK or body[1] != 0:
+            raise ConnectionError(f"MQTT CONNACK refused: {body!r}")
+        return sock
+
+    def _try_reconnect(self) -> bool:
+        """Rebuild the connection and re-subscribe every topic (paho's
+        on_connect-resubscribe pattern). Returns False when shut down or
+        out of retries."""
+        import time as _time
+
+        for attempt in range(self._tries):
+            if self._stop.is_set():
+                return False
+            _time.sleep(self._backoff * (2 ** attempt))
+            try:
+                sock = self._connect()
+                with self._send_lock:
+                    self._sock = sock
+                    for topic in list(self._cbs):
+                        self._pid = (self._pid % 0xFFFF) + 1
+                        sock.sendall(_subscribe_packet(self._pid, topic))
+                log.info("mqtt %s: reconnected (attempt %d)",
+                         self._client_id, attempt + 1)
+                return True
+            except OSError:
+                continue
+        return False
 
     def _loop(self):
-        try:
-            while not self._stop.is_set():
+        while not self._stop.is_set():
+            try:
                 head, body = _read_packet(self._sock)
-                ptype = head & 0xF0
-                if ptype == PUBLISH:
-                    tlen = struct.unpack(">H", body[:2])[0]
-                    topic = body[2:2 + tlen].decode()
-                    cb = self._cbs.get(topic)
-                    if cb is not None:
-                        cb(topic, body[2 + tlen:])
-                elif ptype == SUBACK & 0xF0:
-                    pid = struct.unpack(">H", body[:2])[0]
-                    ev = self._pending_subacks.pop(pid, None)
-                    if ev is not None:
-                        ev.set()
-        except (ConnectionError, OSError):
-            pass
+            except (ConnectionError, OSError):
+                if self._stop.is_set() or not self._reconnect:
+                    return
+                if not self._try_reconnect():
+                    return
+                continue
+            ptype = head & 0xF0
+            if ptype == PUBLISH:
+                tlen = struct.unpack(">H", body[:2])[0]
+                topic = body[2:2 + tlen].decode()
+                cb = self._cbs.get(topic)
+                if cb is not None:
+                    cb(topic, body[2 + tlen:])
+            elif ptype == SUBACK & 0xF0:
+                pid = struct.unpack(">H", body[:2])[0]
+                ev = self._pending_subacks.pop(pid, None)
+                if ev is not None:
+                    ev.set()
+
+    def _ping_loop(self):
+        """PINGREQ every keepalive/2 so the broker (and any NAT between)
+        keeps the connection alive — paho's keepalive loop."""
+        while not self._stop.wait(self._keepalive / 2):
+            try:
+                with self._send_lock:
+                    self._sock.sendall(bytes([PINGREQ, 0]))
+            except OSError:
+                pass  # the receive loop owns reconnection
 
     def subscribe(self, topic: str, callback: Callable[[str, bytes], None],
                   timeout: float = 10.0):

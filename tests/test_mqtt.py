@@ -151,3 +151,35 @@ def test_mqtt_survives_client_killed_mid_exchange():
             c.disconnect()
     finally:
         broker.close()
+
+
+def test_mqtt_client_reconnects_and_resubscribes():
+    """paho-parity reconnect semantics: when the TCP connection drops out
+    from under a live client, it reconnects with backoff, re-subscribes its
+    topics, and keeps receiving (QoS-0: in-flight messages may be lost)."""
+    broker = MiniBroker()
+    try:
+        got = []
+        ev1, ev2 = threading.Event(), threading.Event()
+        sub = MqttClient(broker.host, broker.port, "r",
+                         reconnect_backoff=0.05)
+        sub.subscribe("rt", lambda t, p: (got.append(p), (ev1 if len(got) == 1
+                                                          else ev2).set()))
+        pub = MqttClient(broker.host, broker.port, "p")
+        pub.publish("rt", b"before")
+        assert ev1.wait(10)
+
+        # sever the subscriber's TCP connection out from under it
+        sub._sock.shutdown(2)
+        # give the receive loop time to notice + reconnect + resubscribe
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pub.publish("rt", b"after")
+            if ev2.wait(0.25):
+                break
+        assert ev2.wait(1), "client never recovered after the drop"
+        assert got[-1] == b"after"
+        for c in (sub, pub):
+            c.disconnect()
+    finally:
+        broker.close()
