@@ -53,6 +53,17 @@ REF = "/root/reference"
 if REF not in sys.path:
     sys.path.insert(0, REF)
 
+if "wandb" not in sys.modules:
+    # the reference imports wandb at module scope (fedavg_api.py:7,
+    # fednova_trainer.py); no wandb in this zero-egress image — stub the two
+    # entry points the imported modules reference (the oracle never logs)
+    import types
+
+    _wandb = types.ModuleType("wandb")
+    _wandb.init = lambda *a, **k: None
+    _wandb.log = lambda *a, **k: None
+    sys.modules["wandb"] = _wandb
+
 import flax.linen as nn  # noqa: E402
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
