@@ -58,9 +58,17 @@ def main():
     timed_rounds = int(os.environ.get("BENCH_ROUNDS", 60))
 
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")  # MXU-native default
+    # the bench's packed rows are full by construction (every count ==
+    # samples_per_client, samples % batch == 0), so the engine's
+    # assume_full_clients specialization applies — bit-identical trajectories
+    # (tests/test_fedavg.py), masks/no-op-selects compiled away. Disable with
+    # BENCH_ASSUME_FULL=0 to measure the general ragged-clients path.
+    assume_full = (os.environ.get("BENCH_ASSUME_FULL", "1") == "1"
+                   and n_per_client % batch_size == 0)
     cfg = FedConfig(
         batch_size=batch_size, epochs=epochs, lr=0.1, client_optimizer="sgd",
         client_num_per_round=clients_per_round, dtype=dtype,
+        assume_full_clients=assume_full,
     )
     trainer = ClassificationTrainer(create_model(model_name, output_dim=out_dim, dtype=dtype))
     agg = make_aggregator("fedavg", cfg)

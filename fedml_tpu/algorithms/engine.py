@@ -139,10 +139,15 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
         global_params = global_variables["params"]
         opt_state = opt.init(global_params)
 
+        full = cfg.assume_full_clients
+
         def epoch_body(carry, erng):
             variables, opt_state, steps = carry
             shuffle_rng, step_rng = jax.random.split(erng)
-            if cfg.shuffle:
+            if cfg.shuffle and full:
+                # all rows valid: argsort(u) IS argsort(where(valid,u,inf))
+                perm = jnp.argsort(jax.random.uniform(shuffle_rng, (n_max,)))
+            elif cfg.shuffle:
                 u = jax.random.uniform(shuffle_rng, (n_max,))
                 valid = jnp.arange(n_max) < count
                 perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
@@ -157,7 +162,12 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
             # latency-bound regime — fewer, larger ops win).
             xe = jnp.take(x, perm, axis=0).reshape((nb, b) + x.shape[1:])
             ye = jnp.take(y, perm, axis=0).reshape((nb, b) + y.shape[1:])
-            batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
+            if full:
+                # literal ones: XLA folds the mask multiplies away and the
+                # all-padding-batch selects below turn statically true
+                batch_valid = jnp.ones((nb, b), bool)
+            else:
+                batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
 
             def step_body(carry, scan_in):
                 variables, opt_state, steps = carry
@@ -185,6 +195,12 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
                 (_, (new_state, aux)), grads = grad_fn(variables["params"])
                 updates, new_opt_state = opt.update(grads, opt_state, variables["params"])
                 new_params = optax.apply_updates(variables["params"], updates)
+                if full:
+                    # every batch has data: the no-op-step machinery vanishes
+                    variables = _merge_variables(variables, new_params, new_state)
+                    opt_state = new_opt_state
+                    steps = steps + 1
+                    return (variables, opt_state, steps), aux
                 has_data = jnp.any(bvalid)
                 if stateless_opt:
                     # zero grads already make the update a no-op; only guard

@@ -47,6 +47,14 @@ class FedConfig:
     # trajectories bit-comparable with a fixed-order reference DataLoader —
     # the reference-parity oracle (tests/test_reference_parity.py) relies on it
     shuffle: bool = True
+    # Caller-asserted static shape info: every packed client row is FULL
+    # (counts[i] == n_max) and n_max % batch_size == 0. The engine then drops
+    # the padding-validity machinery (masks become literal ones and fold away,
+    # no-op-step selects disappear) — trajectories are bit-identical to the
+    # general path on data satisfying the contract
+    # (tests/test_fedavg.py::test_assume_full_clients_bit_identical); on data
+    # violating it, padded rows would be trained on. Opt-in.
+    assume_full_clients: bool = False
 
     # federated loop
     comm_round: int = 10

@@ -145,3 +145,39 @@ def test_multi_round_scan_sampling_subset(mnist10):
     gv2, _, metrics = multi(gv, (), jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), base)
     losses = np.asarray(metrics["loss_sum"]) / np.maximum(np.asarray(metrics["total"]), 1.0)
     assert losses[-1] < losses[0]
+
+
+def test_assume_full_clients_bit_identical():
+    """The assume_full_clients specialization must be a pure compile-time
+    simplification: on data satisfying the contract (every count == n_max,
+    n_max % batch == 0) the trajectories are BIT-identical to the general
+    path — same shuffle permutations (argsort(u) == argsort(where(all,u,inf))),
+    masks of literal ones, no-op-step selects statically resolved."""
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    rng = np.random.RandomState(5)
+    C, n = 4, 24
+    x = jnp.asarray(rng.rand(C, n, 12).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 3, size=(C, n)).astype(np.int32))
+    counts = jnp.full((C,), n, jnp.int32)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=3))
+    gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
+
+    for opt_kw in ({"client_optimizer": "sgd", "momentum": 0.9},
+                   {"client_optimizer": "adam", "wd": 1e-3}):
+        cfg = FedConfig(batch_size=8, epochs=2, lr=0.1,
+                        client_num_per_round=C, **opt_kw)
+        agg = make_aggregator("fedavg", cfg)
+        key = jax.random.PRNGKey(3)
+        g1, _, m1 = build_round_fn(trainer, cfg, agg)(
+            gv, agg.init_state(gv), x, y, counts, key)
+        cfg2 = cfg.replace(assume_full_clients=True)
+        g2, _, m2 = build_round_fn(trainer, cfg2, agg)(
+            gv, agg.init_state(gv), x, y, counts, key)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for k2 in m1:
+            assert float(m1[k2]) == float(m2[k2])
