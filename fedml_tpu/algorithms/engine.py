@@ -140,6 +140,10 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
         opt_state = opt.init(global_params)
 
         full = cfg.assume_full_clients
+        if full and n_pad != n_max:
+            raise ValueError(
+                f"assume_full_clients requires n_max ({n_max}) % batch_size "
+                f"({b}) == 0 — padded batches would be trained unmasked")
 
         def epoch_body(carry, erng):
             variables, opt_state, steps = carry

@@ -166,7 +166,8 @@ def test_assume_full_clients_bit_identical():
     trainer = ClassificationTrainer(create_model("lr", output_dim=3))
     gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
 
-    for opt_kw in ({"client_optimizer": "sgd", "momentum": 0.9},
+    for opt_kw in ({"client_optimizer": "sgd"},  # stateless path (bench cfg)
+                   {"client_optimizer": "sgd", "momentum": 0.9},
                    {"client_optimizer": "adam", "wd": 1e-3}):
         cfg = FedConfig(batch_size=8, epochs=2, lr=0.1,
                         client_num_per_round=C, **opt_kw)
@@ -181,3 +182,16 @@ def test_assume_full_clients_bit_identical():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         for k2 in m1:
             assert float(m1[k2]) == float(m2[k2])
+
+
+def test_assume_full_clients_rejects_indivisible_batch():
+    from fedml_tpu.algorithms.engine import build_local_update
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    trainer = ClassificationTrainer(create_model("lr", output_dim=3))
+    cfg = FedConfig(batch_size=10, assume_full_clients=True)
+    lu = build_local_update(trainer, cfg)
+    x = jnp.zeros((24, 12)); y = jnp.zeros((24,), jnp.int32)
+    with pytest.raises(ValueError, match="assume_full_clients"):
+        lu({"params": {}}, x, y, jnp.int32(24), jax.random.PRNGKey(0))
