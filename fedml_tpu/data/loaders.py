@@ -228,7 +228,7 @@ def load_emnist(data_dir="./data", client_num_in_total=10, partition_method="hom
 @register_loader("ILSVRC2012")
 def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
                   image_size=224, cap_per_class=None, byte_budget=None,
-                  global_cap=512, samples_per_client=2048, **_):
+                  global_cap=512, samples_per_client=1024, **_):
     """ImageNet partitioned by class blocks: with 100 clients each owns 10
     consecutive classes, with 1000 each owns one (reference
     ImageNet/data_loader.py:190-240 / datasets.py:81-129 net_dataidx_map).
@@ -272,8 +272,10 @@ def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
         class_num = len(classes)
         dec = make_image_decoder(image_size, readers.IMAGENET_MEAN,
                                  readers.IMAGENET_STD)
+        # default budget sized so the stock config composes: 10 sampled
+        # clients x samples_per_client=1024 rows at 224px f32 ~= 6.2 GB
         budget = int(byte_budget
-                     or _os.environ.get("FEDML_TPU_STREAM_BUDGET", 4 << 30))
+                     or _os.environ.get("FEDML_TPU_STREAM_BUDGET", 8 << 30))
         # class-blocked natural partition: classes split with array_split so
         # EVERY class lands on exactly one client even when
         # class_num % client_num != 0 (reference per-class net_dataidx_map)
