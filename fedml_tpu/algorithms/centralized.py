@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.algorithms.engine import build_eval_fn, build_local_update
 from fedml_tpu.core.config import FedConfig

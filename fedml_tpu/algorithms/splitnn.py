@@ -22,7 +22,6 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from fedml_tpu.core.config import FedConfig

@@ -26,7 +26,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.utils.pytree import tree_size
 
 DEFAULT_PRIME = 2_147_483_647  # 2^31 - 1 (Mersenne), products fit in int64
 

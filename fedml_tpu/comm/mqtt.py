@@ -22,7 +22,7 @@ import logging
 import socket
 import struct
 import threading
-from typing import Any, Callable
+from typing import Callable
 
 from fedml_tpu.comm.message import Message
 

@@ -20,7 +20,6 @@ Concrete trainers mirror the reference's three standalone trainers:
 
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,6 @@ Use: `ClassificationTrainer(module, augment_fn=cifar_train_augment)`.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 
 import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.core.trainer import ClassificationTrainer
 from fedml_tpu.experiments.common import add_args, config_from_args

@@ -15,7 +15,6 @@ mode without carrying running stats through the bi-level grads.
 from __future__ import annotations
 
 from collections import namedtuple
-from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

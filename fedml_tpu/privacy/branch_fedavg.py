@@ -30,7 +30,6 @@ from fedml_tpu.algorithms.aggregators import make_aggregator
 from fedml_tpu.algorithms.engine import build_round_fn
 from fedml_tpu.algorithms.fedavg import client_sampling
 from fedml_tpu.core.config import FedConfig
-from fedml_tpu.data.packing import pack_eval_batches
 from fedml_tpu.data.registry import FederatedDataset
 
 

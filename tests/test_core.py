@@ -1,7 +1,6 @@
 """Unit tests for core contracts: partitioners, packing, pytree ops."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from fedml_tpu.core.partition import (

@@ -69,7 +69,6 @@ def test_dataset_registry_is_wide():
 def test_rnn_nwp_end_to_end():
     """Tiny LSTM trains on the fed_shakespeare surrogate through the full
     engine (NWP loss path, per-position targets)."""
-    import jax
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.core.config import FedConfig

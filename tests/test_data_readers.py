@@ -4,7 +4,6 @@ no surrogate warning may fire. Tiny fixture files are generated per test."""
 
 import gzip
 import logging
-import os
 import pickle
 import struct
 

@@ -1,7 +1,6 @@
 """Experiment CLI mains, metrics logging, checkpoint/resume tests."""
 
 import json
-import os
 
 import numpy as np
 import pytest

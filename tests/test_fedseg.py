@@ -1,6 +1,5 @@
 """FedSeg tests: losses, metrics, LR schedules, end-to-end segmentation FL."""
 
-import dataclasses
 
 import jax.numpy as jnp
 import numpy as np

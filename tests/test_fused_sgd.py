@@ -11,7 +11,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from fedml_tpu.algorithms.aggregators import make_aggregator
 from fedml_tpu.algorithms.engine import build_round_fn

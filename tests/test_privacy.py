@@ -8,7 +8,7 @@ import pytest
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.core.trainer import ClassificationTrainer
 from fedml_tpu.data.registry import load_dataset
-from fedml_tpu.models.ensemble import AdaptiveCNN, ArchSpec, build_hetero_archs
+from fedml_tpu.models.ensemble import AdaptiveCNN, build_hetero_archs
 from fedml_tpu.models.registry import create_model
 from fedml_tpu.privacy.branch_fedavg import BranchFedAvgAPI
 

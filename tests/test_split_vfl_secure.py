@@ -2,7 +2,6 @@
 
 import flax.linen as nn
 import numpy as np
-import pytest
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import load_dataset

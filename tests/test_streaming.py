@@ -103,7 +103,6 @@ def test_imagenet_loader_streams(tmp_path):
 
 def test_streaming_dataset_trains_a_round(tmp_path):
     """A FedAvg round runs off the streaming store end to end."""
-    import jax
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.core.config import FedConfig
