@@ -64,6 +64,19 @@ if "wandb" not in sys.modules:
     _wandb.log = lambda *a, **k: None
     sys.modules["wandb"] = _wandb
 
+if "torchvision" not in sys.modules:
+    # data_preprocessing/utils.py imports torchvision at module scope; the
+    # partition functions under test never touch it (torchvision not in this
+    # image)
+    import types
+
+    _tv = types.ModuleType("torchvision")
+    _tv.datasets = types.ModuleType("torchvision.datasets")
+    _tv.transforms = types.ModuleType("torchvision.transforms")
+    sys.modules["torchvision"] = _tv
+    sys.modules["torchvision.datasets"] = _tv.datasets
+    sys.modules["torchvision.transforms"] = _tv.transforms
+
 import flax.linen as nn  # noqa: E402
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -487,3 +500,31 @@ def test_symmetric_topology_exact_parity():
         for node in range(n):
             assert (ours.get_in_neighbor_idx_list(node)
                     == ref.get_in_neighbor_idx_list(node)), (n, k, node)
+
+
+def test_homo_and_p_hetero_partition_exact_parity():
+    """(h) homo + the fork's p-hetero split vs the living reference
+    (data_preprocessing/utils.py:9-58): identical numpy rng sequences, so
+    identical maps index for index."""
+    from fedml_api.data_preprocessing.utils import (
+        homo_partition as ref_homo,
+        p_hetero_partition as ref_ph,
+    )
+
+    from fedml_tpu.core.partition import homo_partition, p_hetero_partition
+
+    np.random.seed(7)
+    ref_h = ref_homo(103, 5)
+    our_h = homo_partition(103, 5, rng=np.random.RandomState(7))
+    for k in ref_h:
+        np.testing.assert_array_equal(ref_h[k], our_h[k])
+
+    y = np.random.RandomState(9).randint(0, 4, size=240)
+    np.random.seed(21)
+    ref_map = ref_ph(8, y, 0.6)          # 8 clients, 4 classes -> 2 per group
+    our_map = p_hetero_partition(8, y, 0.6, rng=np.random.RandomState(21))
+    assert set(ref_map) == set(our_map)
+    for k in ref_map:
+        np.testing.assert_array_equal(np.asarray(ref_map[k]),
+                                      np.asarray(our_map[k]),
+                                      err_msg=f"client {k} differs")
