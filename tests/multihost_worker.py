@@ -91,6 +91,38 @@ def main():
     init_leaf = np.asarray(variables["params"]["linear"]["kernel"])
     assert np.abs(leaf - init_leaf).max() > 1e-6
 
+    # ---- two-level (groups, clients) hierarchical mesh ACROSS processes:
+    # group g's in-group psums stay on process g's devices (the ICI analog),
+    # the cross-group reduction spans processes (the DCN hop) — SURVEY §2.9's
+    # cloud->group->client mapping deployed on real separate processes
+    from fedml_tpu.algorithms.hierarchical import build_hierarchical_round_fn
+    from fedml_tpu.parallel import build_sharded_hierarchical_round_fn
+
+    G, CG = 2, 4
+    hmesh = Mesh(np.array(jax.devices()).reshape(G, CG), ("groups", "clients"))
+    hx = x_all.reshape(G, CG, n_max, dim)
+    hy = y_all.reshape(G, CG, n_max)
+    hc = counts.reshape(G, CG)
+    hier_vmap = build_hierarchical_round_fn(trainer, cfg, group_comm_round=2)
+    hier_shard = build_sharded_hierarchical_round_fn(trainer, cfg, hmesh,
+                                                     group_comm_round=2)
+    hrng = jax.random.PRNGKey(11)
+    # reference trajectory computed locally on full (seed-identical) data
+    hv_ref, _ = hier_vmap(variables, jnp.asarray(hx), jnp.asarray(hy),
+                          jnp.asarray(hc), hrng)
+    hsh = NamedSharding(hmesh, P("groups", "clients"))
+    ghx = jax.make_array_from_process_local_data(hsh, hx[pid:pid + 1], hx.shape)
+    ghy = jax.make_array_from_process_local_data(hsh, hy[pid:pid + 1], hy.shape)
+    ghc = jax.make_array_from_process_local_data(hsh, hc[pid:pid + 1], hc.shape)
+    hv2, _ = hier_shard(variables, ghx, ghy, ghc, hrng)
+    jax.block_until_ready(hv2)
+    hleaf_ref = np.asarray(hv_ref["params"]["linear"]["kernel"])
+    hleaf = np.asarray(hv2["params"]["linear"]["kernel"])
+    assert np.abs(hleaf - hleaf_ref).max() < 1e-5, (
+        "cross-process two-level mesh drifted from the vmapped round: "
+        f"{np.abs(hleaf - hleaf_ref).max()}")
+    assert_same_across_processes(hleaf.astype(np.float32), "hier_kernel")
+
     round_barrier("test", 1)
     print(f"MULTIHOST_OK pid={pid}")
 
