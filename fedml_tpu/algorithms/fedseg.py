@@ -46,8 +46,11 @@ def segmentation_ce(logits, target, ignore_index: int = 255):
 
 def segmentation_focal(logits, target, gamma: float = 2.0, alpha: float = 0.5,
                        ignore_index: int = 255):
-    """Focal loss built from CE exactly as the reference does
-    (utils.py:97-110: logpt = -CE; loss = -alpha*(1-pt)^gamma * logpt)."""
+    """Per-pixel focal transform of the CE (the standard focal-loss form;
+    kept for callers wanting pixel-level weighting). NB the REFERENCE'S
+    FocalLoss is different — it applies the transform to the batch-mean CE
+    scalar (utils.py:97-110: logpt = -criterion(...), one number) — which
+    `reference_focal_scalar` / SegmentationTrainer reproduce exactly."""
     ce, m = segmentation_ce(logits, target, ignore_index)
     logpt = -ce
     pt = jnp.exp(logpt)
@@ -55,27 +58,50 @@ def segmentation_focal(logits, target, gamma: float = 2.0, alpha: float = 0.5,
     return loss, m
 
 
+def reference_focal_scalar(mean_ce, gamma: float = 2.0, alpha: float = 0.5):
+    """The reference's focal: transform of the batch-mean CE scalar
+    (utils.py:97-110) — logpt = -mean_ce, loss = -alpha*(1-pt)^gamma*logpt."""
+    logpt = -mean_ce
+    pt = jnp.exp(logpt)
+    return -((1 - pt) ** gamma) * alpha * logpt
+
+
 class SegmentationTrainer(ModelTrainer):
     """Per-pixel classification trainer; batch y is [b, h, w] int labels with
-    255 = ignore (reference fedseg trainer + SegmentationLosses)."""
+    255 = ignore (reference fedseg trainer + SegmentationLosses).
 
-    def __init__(self, module, loss_type: str = "ce", ignore_index: int = 255, id: int = 0):
+    Training-loss SCALE matches the reference exactly so its launch-script
+    learning rates transfer verbatim: the CE is size_average'd over valid
+    pixels then divided AGAIN by the batch size (the reference's
+    batch_average quirk, utils.py:90-95), and "focal" applies the focal
+    transform to the batch-mean CE scalar (utils.py:97-110), not per pixel
+    — both asserted against the living reference by
+    tests/test_reference_parity.py::test_segmentation_loss_parity."""
+
+    def __init__(self, module, loss_type: str = "ce", ignore_index: int = 255,
+                 id: int = 0, batch_average: bool = True):
         super().__init__(module, id)
         self.loss_type = loss_type
         self.ignore_index = ignore_index
-
-    def _loss(self, logits, y):
-        if self.loss_type == "focal":
-            return segmentation_focal(logits, y, ignore_index=self.ignore_index)
-        return segmentation_ce(logits, y, ignore_index=self.ignore_index)
+        self.batch_average = batch_average
 
     def loss_fn(self, variables, batch, rng, train: bool = True):
         logits, new_state = self.apply(variables, batch["x"], rng, train)
-        per, pix_mask = self._loss(logits, batch["y"])
+        per, pix_mask = segmentation_ce(logits, batch["y"],
+                                        ignore_index=self.ignore_index)
         samp = batch["mask"].astype(per.dtype).reshape((-1,) + (1,) * (per.ndim - 1))
         m = pix_mask * samp
         denom = jnp.maximum(m.sum(), 1.0)
-        loss = (per * m).sum() / denom
+        mean_ce = (per * m).sum() / denom
+        if self.loss_type == "focal":
+            loss = reference_focal_scalar(mean_ce)
+        else:
+            loss = mean_ce
+        if self.batch_average:
+            # reference divides the (already pixel-averaged) loss by the
+            # batch size again; n = the batch dim as the reference's
+            # logit.size(0) (it never pads)
+            loss = loss / logits.shape[0]
         pred = jnp.argmax(logits, -1)
         correct = ((pred == batch["y"]) * m).sum()
         aux = {"loss_sum": (per * m).sum(), "correct": correct, "total": m.sum()}
@@ -83,7 +109,8 @@ class SegmentationTrainer(ModelTrainer):
 
     def eval_fn(self, variables, batch):
         logits, _ = self.apply(variables, batch["x"], None, train=False)
-        per, pix_mask = self._loss(logits, batch["y"])
+        per, pix_mask = segmentation_ce(logits, batch["y"],
+                                        ignore_index=self.ignore_index)
         samp = batch["mask"].astype(per.dtype).reshape((-1,) + (1,) * (per.ndim - 1))
         m = pix_mask * samp
         pred = jnp.argmax(logits, -1)

@@ -90,7 +90,10 @@ def test_fedseg_end_to_end():
     ds = FederatedDataset(name="synthseg", train=packed, test=packed,
                           train_global=(flat_x, flat_y),
                           test_global=(flat_x[:32], flat_y[:32]), class_num=2)
-    cfg = FedConfig(comm_round=8, batch_size=8, lr=0.1, epochs=5, momentum=0.9,
+    # lr scaled by the batch size: the trainer reproduces the reference's
+    # batch_average loss scale (mean-CE / n), under which the old 0.1 is
+    # effectively 0.1/8
+    cfg = FedConfig(comm_round=8, batch_size=8, lr=0.8, epochs=5, momentum=0.9,
                     client_num_in_total=C, client_num_per_round=C, ci=1,
                     frequency_of_the_test=7)
     api = FedAvgAPI(ds, cfg, SegmentationTrainer(SimpleFCN(output_dim=2, width=8)))

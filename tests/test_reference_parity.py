@@ -528,3 +528,52 @@ def test_homo_and_p_hetero_partition_exact_parity():
         np.testing.assert_array_equal(np.asarray(ref_map[k]),
                                       np.asarray(our_map[k]),
                                       err_msg=f"client {k} differs")
+
+
+def test_segmentation_loss_parity():
+    """(i) FedSeg training losses vs the living reference SegmentationLosses
+    (fedseg/utils.py:71-110), including its quirks: size_average'd CE divided
+    AGAIN by batch size (batch_average), and focal applied to the batch-mean
+    CE scalar rather than per pixel."""
+    from fedml_api.distributed.fedseg.utils import SegmentationLosses
+
+    from fedml_tpu.algorithms.fedseg import (
+        reference_focal_scalar,
+        segmentation_ce,
+    )
+
+    rng = np.random.RandomState(0)
+    n, c, h, w = 3, 5, 6, 6
+    logits = rng.normal(size=(n, c, h, w)).astype(np.float32)
+    target = rng.randint(0, c, size=(n, h, w)).astype(np.int64)
+    target[0, :2, :2] = 255  # ignore region
+
+    losses = SegmentationLosses(ignore_index=255)
+    ref_ce = float(losses.CrossEntropyLoss(torch.tensor(logits), torch.tensor(target)))
+    ref_focal = float(losses.FocalLoss(torch.tensor(logits), torch.tensor(target)))
+
+    jl = jnp.asarray(np.transpose(logits, (0, 2, 3, 1)))  # NHWC
+    jt = jnp.asarray(target.astype(np.int32))
+    per, m = segmentation_ce(jl, jt, ignore_index=255)
+    mean_ce = float((per * m).sum() / m.sum())
+    ours_ce = mean_ce / n
+    ours_focal = float(reference_focal_scalar(jnp.float32(mean_ce))) / n
+    np.testing.assert_allclose(ours_ce, ref_ce, rtol=1e-5)
+    np.testing.assert_allclose(ours_focal, ref_focal, rtol=1e-5)
+
+
+def test_gkt_kl_loss_parity():
+    """(j) FedGKT's distillation loss vs the living reference KL_Loss
+    (fedgkt/utils.py:75-94): T^2 * batchmean KL with the +1e-7 regularizer."""
+    from fedml_api.distributed.fedgkt.utils import KL_Loss
+
+    from fedml_tpu.algorithms.fedgkt import kd_kl_loss
+
+    rng = np.random.RandomState(1)
+    student = rng.normal(size=(6, 10)).astype(np.float32)
+    teacher = rng.normal(size=(6, 10)).astype(np.float32) * 2
+    for T in (1.0, 3.0):
+        ref = float(KL_Loss(T)(torch.tensor(student), torch.tensor(teacher)))
+        ours = float(jnp.mean(kd_kl_loss(jnp.asarray(student),
+                                         jnp.asarray(teacher), T)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=1e-6)
