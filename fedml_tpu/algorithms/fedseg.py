@@ -195,7 +195,8 @@ class FedSegAPI:
                 cm, loss_sum, n_sum = carry
                 x, y, m = batch
                 logits, _ = model_trainer.apply(variables, x, None, train=False)
-                per, pix_mask = model_trainer._loss(logits, y)
+                per, pix_mask = segmentation_ce(
+                    logits, y, ignore_index=model_trainer.ignore_index)
                 samp = m.astype(per.dtype).reshape((-1,) + (1,) * (per.ndim - 1))
                 mm = pix_mask * samp
                 pred = jnp.argmax(logits, -1)
