@@ -100,3 +100,33 @@ def test_fedseg_end_to_end():
     hist = api.train()
     assert hist[-1]["Test/Acc"] > 0.75  # pixel accuracy on the easy task
     assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+
+
+def test_fedseg_api_evaluate_metrics():
+    """FedSegAPI.evaluate (the fused confusion-matrix eval path) runs and
+    returns sane segmentation metrics — direct unit coverage for cm_batches,
+    which a past refactor broke while only the CLI smoke exercised it."""
+    from fedml_tpu.algorithms.fedseg import FedSegAPI, SegmentationTrainer
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.packing import PackedClients
+    from fedml_tpu.data.registry import FederatedDataset
+
+    rng = np.random.RandomState(2)
+    C, n, h, w = 2, 8, 16, 16
+    x = rng.rand(C, n, h, w, 1).astype(np.float32)
+    y = rng.randint(0, 2, size=(C, n, h, w)).astype(np.int32)
+    y[0, 0, :2, :2] = 255
+    packed = PackedClients(x, y, np.full(C, n, np.int32))
+    ds = FederatedDataset(name="synthseg", train=packed, test=packed,
+                          train_global=(x.reshape(-1, h, w, 1), y.reshape(-1, h, w)),
+                          test_global=(x.reshape(-1, h, w, 1)[:8], y.reshape(-1, h, w)[:8]),
+                          class_num=2)
+    cfg = FedConfig(comm_round=1, batch_size=4, lr=0.1, epochs=1,
+                    client_num_in_total=C, client_num_per_round=C)
+    api = FedSegAPI(ds, cfg, SegmentationTrainer(SimpleFCN(output_dim=2, width=4)))
+    api.train_one_round(0)
+    keeper = api.evaluate()  # reference-parity EvaluationMetricsKeeper
+    for v in (keeper.accuracy, keeper.accuracy_class, keeper.mIoU,
+              keeper.FWIoU, keeper.loss):
+        assert np.isfinite(v), vars(keeper)
+    assert 0.0 <= keeper.mIoU <= 1.0
