@@ -147,9 +147,15 @@ class NWPTrainer(ModelTrainer):
 
     def eval_fn(self, variables, batch):
         _, _, aux = self._masked_ce(variables, batch, None, False)
+        # reported-loss contract matches the reference trainer
+        # (my_model_trainer_nwp.py:72-80): each batch contributes
+        # meanCE-over-non-pad x batch_size, later divided by test_total
+        # (non-pad tokens) — reproduced so Test/Loss numbers line up
+        n_tok = jnp.maximum(aux["total"], 1.0)
+        n_samples = batch["mask"].astype(jnp.float32).sum()
         return {
             "test_correct": aux["correct"],
-            "test_loss": aux["loss_sum"],
+            "test_loss": aux["loss_sum"] / n_tok * n_samples,
             "test_total": aux["total"],
         }
 
@@ -169,16 +175,29 @@ class TagPredictionTrainer(ModelTrainer):
         return loss, (new_state, aux)
 
     def eval_fn(self, variables, batch):
+        """Reference metric contract (my_model_trainer_tag_prediction.py
+        test():75-96): BCE summed over all labels (x batch_size, divided
+        back out by the test_total aggregation), exact-match correct, and
+        per-sample (macro) precision/recall sums with the 1e-13 guard."""
         logits, _ = self.apply(variables, batch["x"], None, train=False)
-        y = batch["y"].astype(logits.dtype)
-        pred = (jax.nn.sigmoid(logits) > 0.5).astype(logits.dtype)
-        mask = batch["mask"].astype(logits.dtype)[:, None]
-        per = optax.sigmoid_binary_cross_entropy(logits, y).mean(axis=-1)
-        tp = (pred * y * mask).sum()
+        y = batch["y"].astype(jnp.float32)
+        probs = jax.nn.sigmoid(logits).astype(jnp.float32)
+        predicted = (probs > 0.5).astype(jnp.float32)
+        samp = batch["mask"].astype(jnp.float32)
+        n_valid = samp.sum()
+        # BCELoss(reduction="sum") over valid samples
+        eps = 1e-7
+        bce = -(y * jnp.log(jnp.maximum(probs, eps))
+                + (1 - y) * jnp.log(jnp.maximum(1 - probs, eps)))
+        loss_sum = (bce.sum(axis=-1) * samp).sum()
+        exact = (jnp.abs(predicted - y).max(axis=-1) < 0.5).astype(jnp.float32)
+        tp = ((y * predicted) > 0.1).astype(jnp.float32).sum(axis=-1)
+        precision = tp / (predicted.sum(axis=-1) + 1e-13)
+        recall = tp / (y.sum(axis=-1) + 1e-13)
         return {
-            "test_loss": (per * batch["mask"].astype(per.dtype)).sum(),
-            "test_tp": tp,
-            "test_pred_pos": (pred * mask).sum(),
-            "test_true_pos": (y * mask).sum(),
-            "test_total": batch["mask"].astype(per.dtype).sum(),
+            "test_correct": (exact * samp).sum(),
+            "test_loss": loss_sum * n_valid,
+            "test_precision": (precision * samp).sum(),
+            "test_recall": (recall * samp).sum(),
+            "test_total": n_valid,
         }

@@ -577,3 +577,91 @@ def test_gkt_kl_loss_parity():
         ours = float(jnp.mean(kd_kl_loss(jnp.asarray(student),
                                          jnp.asarray(teacher), T)))
         np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=1e-6)
+
+
+class _FixedOut(torch.nn.Module):
+    """Torch stub returning precomputed outputs — drives the reference
+    trainers' test() with known tensors."""
+
+    def __init__(self, out):
+        super().__init__()
+        self.out = out
+
+    def forward(self, x):
+        return self.out
+
+
+def test_nwp_eval_metrics_parity():
+    """(k) NWP masked eval vs the living reference trainer
+    (my_model_trainer_nwp.py:54-81): identical correct/total, and the
+    reported-loss contract (meanCE-over-non-pad x batch_size)."""
+    from fedml_api.standalone.fedavg.my_model_trainer_nwp import (
+        MyModelTrainer as RefNWP,
+    )
+
+    from fedml_tpu.core.trainer import NWPTrainer
+
+    rng = np.random.RandomState(0)
+    B, T, V = 6, 10, 12
+    logits = rng.normal(size=(B, T, V)).astype(np.float32)
+    y = rng.randint(0, V, size=(B, T)).astype(np.int64)
+    y[:, 7:] = 0  # pad tail (ignore_index 0)
+
+    ref = RefNWP(_FixedOut(torch.tensor(np.transpose(logits, (0, 2, 1)))))
+    loader = [(torch.zeros(B, T), torch.tensor(y))]
+    ref_m = ref.test(loader, torch.device("cpu"), None)
+
+    class _JaxFixed(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return jnp.asarray(logits)
+
+    tr = NWPTrainer(_JaxFixed(), pad_id=0)
+    ours = tr.eval_fn({"params": {}},
+                      {"x": jnp.zeros((B, T)), "y": jnp.asarray(y.astype(np.int32)),
+                       "mask": jnp.ones(B)})
+    assert float(ours["test_correct"]) == ref_m["test_correct"]
+    assert float(ours["test_total"]) == ref_m["test_total"]
+    np.testing.assert_allclose(float(ours["test_loss"]), ref_m["test_loss"],
+                               rtol=1e-5)
+
+
+def test_tag_prediction_eval_metrics_parity():
+    """(l) Multi-label tag eval vs the living reference trainer
+    (my_model_trainer_tag_prediction.py:56-96): exact-match correct,
+    macro precision/recall sums, sum-BCE x batch_size loss."""
+    from fedml_api.standalone.fedavg.my_model_trainer_tag_prediction import (
+        MyModelTrainer as RefTag,
+    )
+
+    from fedml_tpu.core.trainer import TagPredictionTrainer
+
+    rng = np.random.RandomState(1)
+    B, L = 8, 9
+    probs = rng.rand(B, L).astype(np.float32) * 0.98 + 0.01
+    y = (rng.rand(B, L) < 0.3).astype(np.float32)
+    y[0] = (probs[0] > 0.5)  # guarantee one exact match
+
+    ref = RefTag(_FixedOut(torch.tensor(probs)))
+    loader = [(torch.zeros(B, 4), torch.tensor(y))]
+    ref_m = ref.test(loader, torch.device("cpu"), None)
+
+    logits = np.log(probs / (1 - probs))  # sigmoid^-1 so our model sees probs
+
+    class _JaxFixed(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return jnp.asarray(logits)
+
+    tr = TagPredictionTrainer(_JaxFixed())
+    ours = tr.eval_fn({"params": {}},
+                      {"x": jnp.zeros((B, 4)), "y": jnp.asarray(y),
+                       "mask": jnp.ones(B)})
+    assert float(ours["test_correct"]) == ref_m["test_correct"]
+    assert float(ours["test_total"]) == ref_m["test_total"]
+    np.testing.assert_allclose(float(ours["test_precision"]),
+                               ref_m["test_precision"], rtol=1e-4)
+    np.testing.assert_allclose(float(ours["test_recall"]),
+                               ref_m["test_recall"], rtol=1e-4)
+    np.testing.assert_allclose(float(ours["test_loss"]), ref_m["test_loss"],
+                               rtol=1e-4)
