@@ -769,3 +769,94 @@ def test_decentralized_dsgd_trajectory_parity():
     ours_b = np.asarray(z_vars["params"]["lin"]["bias"])
     np.testing.assert_allclose(ours_w, ref_w, rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(ours_b, ref_b, rtol=1e-4, atol=1e-6)
+
+
+def test_decentralized_pushsum_trajectory_parity():
+    """(n) Push-sum over a DIRECTED (row-stochastic, non-doubly-stochastic)
+    mixing matrix vs the living reference ClientPushsum
+    (client_pushsum.py:57-130): grads at z, x-update, W^T-weighted mixing
+    with omega mass tracking, z = x/omega. Same aliasing snapshot as the
+    DSGD oracle; the matrix is injected directly into both sides so the test
+    does not depend on rng-identical asymmetric graph generation."""
+    from fedml_api.standalone.decentralized.client_pushsum import ClientPushsum
+
+    from fedml_tpu.algorithms.decentralized import build_gossip_step
+
+    rng = np.random.RandomState(3)
+    n, d, iters = 4, 5, 5
+    # hand-built directed row-stochastic W (columns NOT stochastic)
+    adj = np.array([[1, 1, 0, 1],
+                    [0, 1, 1, 0],
+                    [1, 1, 1, 0],
+                    [0, 0, 1, 1]], np.float32)
+    W = adj / adj.sum(axis=1, keepdims=True)
+
+    class _StubTopo:
+        def get_asymmetric_neighbor_list(self, i):
+            return W[i]
+
+        def get_symmetric_neighbor_list(self, i):  # pragma: no cover
+            return W[i]
+
+    streams = [[{"x": rng.normal(size=(d,)).astype(np.float64),
+                 "y": float(rng.randint(0, 2))} for _ in range(iters)]
+               for _ in range(n)]
+    w0 = [rng.normal(size=(1, d)).astype(np.float32) * 0.3 for _ in range(n)]
+    b0 = [rng.normal(size=(1,)).astype(np.float32) * 0.1 for _ in range(n)]
+    lr = 0.2
+
+    def make_model(i):
+        m = torch.nn.Sequential(torch.nn.Linear(d, 1), torch.nn.Sigmoid())
+        with torch.no_grad():
+            m[0].weight.copy_(torch.tensor(w0[i]))
+            m[0].bias.copy_(torch.tensor(b0[i]))
+        return m
+
+    clients = [ClientPushsum(make_model(i), make_model(i), i, streams[i],
+                             _StubTopo(), iters, lr, 1, 0.0, 0.0,
+                             b_symmetric=False, time_varying=False)
+               for i in range(n)]
+    for t in range(iters):
+        for c in clients:
+            c.train(t)
+        for c in clients:
+            c.send_local_gradient_to_neighbor(clients)
+        for c in clients:  # snapshot (same aliasing defect as ClientDSGD)
+            c.neighbors_weight_dict = {k: copy.deepcopy(v)
+                                       for k, v in c.neighbors_weight_dict.items()}
+        for c in clients:
+            c.update_local_parameters()
+    ref_w = np.stack([c.model[0].weight.detach().numpy() for c in clients])
+    ref_omega = np.array([c.omega for c in clients], np.float32)
+
+    class _SigmoidLinear(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return jax.nn.sigmoid(nn.Dense(1, name="lin")(x))
+
+    class _BCETrainer:
+        module = _SigmoidLinear()
+
+        def loss_fn(self, variables, batch, rng, train=True):
+            p = self.module.apply(variables, batch["x"])[:, 0]
+            y = batch["y"]
+            eps = 1e-12
+            l = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)).mean()
+            return l, ({}, {"loss": l})
+
+    step = build_gossip_step(_BCETrainer(), FedConfig(lr=lr), push_sum=True)
+    stack = lambda arrs: jnp.asarray(np.stack(arrs))
+    params = {"params": {"lin": {"kernel": stack([w.T for w in w0]),
+                                 "bias": stack(b0)}}}
+    x_params, z_vars, omega = params["params"], params, jnp.ones(n)
+    key = jax.random.PRNGKey(0)
+    for t in range(iters):
+        batch = {"x": stack([streams[i][t]["x"].astype(np.float32)[None]
+                             for i in range(n)]),
+                 "y": jnp.asarray([[streams[i][t]["y"]] for i in range(n)],
+                                  jnp.float32)}
+        x_params, omega, z_vars, _ = step(x_params, omega, z_vars, batch,
+                                          jnp.asarray(W), jax.random.fold_in(key, t))
+    np.testing.assert_allclose(np.asarray(omega), ref_omega, rtol=1e-5)
+    ours_w = np.asarray(z_vars["params"]["lin"]["kernel"]).transpose(0, 2, 1)
+    np.testing.assert_allclose(ours_w, ref_w, rtol=1e-4, atol=1e-6)
