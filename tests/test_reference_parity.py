@@ -671,6 +671,26 @@ def test_tag_prediction_eval_metrics_parity():
                                rtol=1e-4)
 
 
+class _SigmoidLinearTwin(nn.Module):
+    """Flax twin of the reference decentralized clients' model
+    (Linear + Sigmoid; BCELoss on probabilities)."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        return jax.nn.sigmoid(nn.Dense(1, name="lin")(x))
+
+
+class _BCEStreamTrainer:
+    module = _SigmoidLinearTwin()
+
+    def loss_fn(self, variables, batch, rng, train=True):
+        p = self.module.apply(variables, batch["x"])[:, 0]
+        y = batch["y"]
+        eps = 1e-12
+        l = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)).mean()
+        return l, ({}, {"loss": l})
+
+
 def test_decentralized_dsgd_trajectory_parity():
     """(m) Decentralized DSGD vs the living reference ClientDSGD
     (client_dsgd.py:54-102): grads at z_t, x_{t+1/2} = x_t - lr*grad, gossip
@@ -730,27 +750,12 @@ def test_decentralized_dsgd_trajectory_parity():
     ref_b = np.stack([c.model[0].bias.detach().numpy() for c in clients])
 
     # ---- jitted gossip step ----------------------------------------------
-    class _SigmoidLinear(nn.Module):
-        @nn.compact
-        def __call__(self, x, train=False):
-            return jax.nn.sigmoid(nn.Dense(1, name="lin")(x))
-
-    class _BCETrainer:
-        module = _SigmoidLinear()
-
-        def loss_fn(self, variables, batch, rng, train=True):
-            p = self.module.apply(variables, batch["x"])[:, 0]
-            y = batch["y"]
-            eps = 1e-12
-            l = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)).mean()
-            return l, ({}, {"loss": l})
-
     topo = SymmetricTopologyManager(n, neighbor_num=2)
     topo.generate_topology()
     W = jnp.asarray(np.stack([topo.get_in_neighbor_weights(i)
                               for i in range(n)]).astype(np.float32))
     cfg = FedConfig(lr=lr)
-    step = build_gossip_step(_BCETrainer(), cfg)
+    step = build_gossip_step(_BCEStreamTrainer(), cfg)
     stack = lambda arrs: jnp.asarray(np.stack(arrs))
     params = {"params": {"lin": {"kernel": stack([w.T for w in w0]),
                                  "bias": stack(b0)}}}
@@ -829,22 +834,7 @@ def test_decentralized_pushsum_trajectory_parity():
     ref_w = np.stack([c.model[0].weight.detach().numpy() for c in clients])
     ref_omega = np.array([c.omega for c in clients], np.float32)
 
-    class _SigmoidLinear(nn.Module):
-        @nn.compact
-        def __call__(self, x, train=False):
-            return jax.nn.sigmoid(nn.Dense(1, name="lin")(x))
-
-    class _BCETrainer:
-        module = _SigmoidLinear()
-
-        def loss_fn(self, variables, batch, rng, train=True):
-            p = self.module.apply(variables, batch["x"])[:, 0]
-            y = batch["y"]
-            eps = 1e-12
-            l = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)).mean()
-            return l, ({}, {"loss": l})
-
-    step = build_gossip_step(_BCETrainer(), FedConfig(lr=lr), push_sum=True)
+    step = build_gossip_step(_BCEStreamTrainer(), FedConfig(lr=lr), push_sum=True)
     stack = lambda arrs: jnp.asarray(np.stack(arrs))
     params = {"params": {"lin": {"kernel": stack([w.T for w in w0]),
                                  "bias": stack(b0)}}}
@@ -860,3 +850,6 @@ def test_decentralized_pushsum_trajectory_parity():
     np.testing.assert_allclose(np.asarray(omega), ref_omega, rtol=1e-5)
     ours_w = np.asarray(z_vars["params"]["lin"]["kernel"]).transpose(0, 2, 1)
     np.testing.assert_allclose(ours_w, ref_w, rtol=1e-4, atol=1e-6)
+    ref_b = np.stack([c.model[0].bias.detach().numpy() for c in clients])
+    ours_b = np.asarray(z_vars["params"]["lin"]["bias"])
+    np.testing.assert_allclose(ours_b, ref_b, rtol=1e-4, atol=1e-6)
