@@ -853,3 +853,92 @@ def test_decentralized_pushsum_trajectory_parity():
     ref_b = np.stack([c.model[0].bias.detach().numpy() for c in clients])
     ours_b = np.asarray(z_vars["params"]["lin"]["bias"])
     np.testing.assert_allclose(ours_b, ref_b, rtol=1e-4, atol=1e-6)
+
+
+def test_neural_vfl_trajectory_parity():
+    """(o) Neural vertical FL vs the living reference guest/host party stack
+    (party_models.py:12-118 + finance/vfl_models_standalone.py:6-75):
+    LocalModel (Linear+LeakyReLU) -> DenseModel logit components, guest sums,
+    BCE-with-logits common gradient, per-sub-model SGD(momentum .9, wd .01) —
+    all parties' weights match over 4 joint steps."""
+    from fedml_api.model.finance.vfl_models_standalone import (
+        DenseModel as RefDense,
+        LocalModel as RefLocal,
+    )
+    from fedml_api.standalone.classical_vertical_fl.party_models import (
+        VFLGuestModel,
+        VFLHostModel,
+    )
+    from fedml_api.standalone.classical_vertical_fl.vfl import (
+        VerticalMultiplePartyLogisticRegressionFederatedLearning as RefVFL,
+    )
+
+    from fedml_tpu.algorithms.vfl import build_neural_vfl_step
+
+    rng = np.random.RandomState(0)
+    B, dims, H, lr, steps = 12, [3, 4], 5, 0.05, 4
+    Xa = rng.normal(size=(B, dims[0])).astype(np.float32)
+    Xb = rng.normal(size=(B, dims[1])).astype(np.float32)
+    y = rng.randint(0, 2, size=(B, 1)).astype(np.float32)
+    inits = []
+    for d in dims:
+        inits.append({
+            "local_w": rng.normal(0, 0.4, (d, H)).astype(np.float32),
+            "local_b": rng.normal(0, 0.1, (H,)).astype(np.float32),
+            "dense_w": rng.normal(0, 0.3, (H, 1)).astype(np.float32),
+            "dense_b": rng.normal(0, 0.1, (1,)).astype(np.float32),
+        })
+
+    # ---- reference actors -------------------------------------------------
+    def port(torch_linear, w, b=None):
+        with torch.no_grad():
+            torch_linear.weight.copy_(torch.tensor(w.T))
+            if b is not None:
+                torch_linear.bias.copy_(torch.tensor(b))
+
+    guest_local = RefLocal(dims[0], H, lr)
+    port(guest_local.classifier[0], inits[0]["local_w"], inits[0]["local_b"])
+    guest = VFLGuestModel(guest_local)
+    guest_dense = RefDense(H, 1, learning_rate=lr, bias=True)
+    port(guest_dense.classifier[0], inits[0]["dense_w"], inits[0]["dense_b"])
+    guest.set_dense_model(guest_dense)
+
+    host_local = RefLocal(dims[1], H, lr)
+    port(host_local.classifier[0], inits[1]["local_w"], inits[1]["local_b"])
+    host = VFLHostModel(host_local)
+    host_dense = RefDense(H, 1, learning_rate=lr, bias=False)
+    port(host_dense.classifier[0], inits[1]["dense_w"])
+    host.set_dense_model(host_dense)
+
+    fed = RefVFL(guest)
+    fed.add_party(id="host", party_model=host)
+    for t in range(steps):
+        fed.fit(Xa, y, {"host": Xb}, global_step=t)
+    ref_lw = [guest_local.classifier[0].weight.detach().numpy().T,
+              host_local.classifier[0].weight.detach().numpy().T]
+    ref_dw = [guest_dense.classifier[0].weight.detach().numpy().T,
+              host_dense.classifier[0].weight.detach().numpy().T]
+
+    # ---- jitted joint step ------------------------------------------------
+    step, _, opt = build_neural_vfl_step(lr=lr, momentum=0.9, wd=0.01)
+    params = []
+    for k, init in enumerate(inits):
+        p = {"local_w": jnp.asarray(init["local_w"]),
+             "local_b": jnp.asarray(init["local_b"]),
+             "dense_w": jnp.asarray(init["dense_w"])}
+        if k == 0:
+            p["dense_b"] = jnp.asarray(init["dense_b"])
+        params.append(p)
+    params = tuple(params)
+    opt_state = opt.init(params)
+    xs = (jnp.asarray(Xa), jnp.asarray(Xb))
+    for t in range(steps):
+        params, opt_state, loss = step(params, opt_state, xs,
+                                       jnp.asarray(y[:, 0]))
+    for k in range(2):
+        np.testing.assert_allclose(np.asarray(params[k]["local_w"]), ref_lw[k],
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"party {k} local_w")
+        np.testing.assert_allclose(np.asarray(params[k]["dense_w"]), ref_dw[k],
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"party {k} dense_w")
