@@ -73,8 +73,10 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
             from fedml_tpu.models.darts import gumbel_softmax_st
 
             r1, r2 = jax.random.split(grng)
-            wn = gumbel_softmax_st(r1, alphas[0], tau)
-            wr = gumbel_softmax_st(r2, alphas[1], tau)
+            # one independent sample per cell (reference draws fresh inside
+            # every cell forward, model_search_gdas.py:125-129)
+            wn = gumbel_softmax_st(r1, alphas[0], tau, num=network.layers)
+            wr = gumbel_softmax_st(r2, alphas[1], tau, num=network.layers)
             logits = network.apply({"params": params}, x, alphas[0], alphas[1],
                                    train=True, weights_normal=wn,
                                    weights_reduce=wr)

@@ -99,9 +99,11 @@ class SegmentationTrainer(ModelTrainer):
             loss = mean_ce
         if self.batch_average:
             # reference divides the (already pixel-averaged) loss by the
-            # batch size again; n = the batch dim as the reference's
-            # logit.size(0) (it never pads)
-            loss = loss / logits.shape[0]
+            # batch size again (logit.size(0), utils.py:90-95). It never
+            # pads, so the engine's padded final batch must divide by the
+            # VALID sample count, not the static batch dim — otherwise the
+            # loss/grad scale diverges by valid/b on partial batches.
+            loss = loss / jnp.maximum(batch["mask"].sum(), 1.0)
         pred = jnp.argmax(logits, -1)
         correct = ((pred == batch["y"]) * m).sum()
         aux = {"loss_sum": (per * m).sum(), "correct": correct, "total": m.sum()}

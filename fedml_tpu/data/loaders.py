@@ -293,12 +293,24 @@ def load_imagenet(data_dir="./data", client_num_in_total=100, seed=0,
             # row at 224px f32 is n_max*600KB, so cap each client's list with
             # a seeded subsample to keep round memory inside the budget
             srng = np.random.RandomState(seed + 7)
+            capped = dropped = 0
             for k in range(len(cf)):
                 if len(cf[k]) > samples_per_client:
+                    capped += 1
+                    dropped += len(cf[k]) - samples_per_client
                     keep = np.sort(srng.choice(len(cf[k]), samples_per_client,
                                                replace=False))
                     cf[k] = [cf[k][i] for i in keep]
                     cl[k] = cl[k][keep]
+            if capped:
+                # behavioral deviation from the reference (which trains on
+                # each client's full class block) — never cap silently
+                sources.log.warning(
+                    "ILSVRC streaming loader subsampled %d/%d clients to "
+                    "samples_per_client=%d (dropped %d images total); pass "
+                    "samples_per_client=None for reference-faithful full "
+                    "class blocks", capped, len(cf), samples_per_client,
+                    dropped)
         train = StreamingPackedClients(cf, cl, dec, byte_budget=budget)
         # homo-partitioned per-client test split over the val files
         te_files = [f for ci in range(class_num) for f in te_pc[ci]]

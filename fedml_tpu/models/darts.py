@@ -211,7 +211,9 @@ class DARTSNetwork(nn.Module):
                  weights_normal=None, weights_reduce=None):
         # precomputed mixing weights override the softmax (the GDAS variant
         # passes straight-through gumbel-softmax samples — reference
-        # model_search_gdas.py:122-129 Network_GumbelSoftmax.forward)
+        # model_search_gdas.py:122-129 Network_GumbelSoftmax.forward). A 3-D
+        # [layers, k, ops] weight carries one independent sample per cell,
+        # matching the reference's fresh per-cell draw.
         wn = (weights_normal if weights_normal is not None
               else nn.softmax(alphas_normal, axis=-1))
         wr = (weights_reduce if weights_reduce is not None
@@ -225,24 +227,32 @@ class DARTSNetwork(nn.Module):
             reduction = i in (self.layers // 3, 2 * self.layers // 3)
             if reduction:
                 c_curr *= 2
+            w = wr if reduction else wn
+            if w.ndim == 3:
+                w = w[i]
             s0, s1 = s1, Cell(
                 channels=c_curr, reduction=reduction, reduction_prev=reduction_prev,
                 steps=self.steps, multiplier=self.multiplier, name=f"cell{i}"
-            )(s0, s1, wr if reduction else wn)
+            )(s0, s1, w)
             reduction_prev = reduction
         out = jnp.mean(s1, axis=(1, 2))
         return nn.Dense(self.output_dim, name="classifier")(out)
 
 
-def gumbel_softmax_st(rng, alphas, tau: float = 5.0):
+def gumbel_softmax_st(rng, alphas, tau: float = 5.0, num: int | None = None):
     """Hard straight-through gumbel-softmax over the primitive axis —
     torch F.gumbel_softmax(alphas, tau, hard=True) semantics (reference
     model_search_gdas.py:127-129): forward = one-hot of the perturbed argmax,
-    backward = soft sample's gradient."""
+    backward = soft sample's gradient.
+
+    ``num`` draws that many independent samples at once ([num, k, ops]) — one
+    per cell, mirroring the reference's fresh draw inside each cell's forward
+    (Network_GumbelSoftmax.forward:125-129)."""
     import jax
 
+    shape = alphas.shape if num is None else (num,) + alphas.shape
     g = -jnp.log(-jnp.log(
-        jax.random.uniform(rng, alphas.shape, minval=1e-10, maxval=1.0) + 1e-10))
+        jax.random.uniform(rng, shape, minval=1e-10, maxval=1.0) + 1e-10))
     soft = nn.softmax((alphas + g) / tau, axis=-1)
     hard = jax.nn.one_hot(jnp.argmax(soft, axis=-1), alphas.shape[-1],
                           dtype=soft.dtype)

@@ -245,16 +245,21 @@ class GradientVectorAttack:
 
     def _dataset(self, pred_fn, grad_fn, member, nonmember):
         # fit() then score() on the same arrays is the common path — reuse
-        # the features instead of re-running the model + gradient sweeps
-        key = tuple(id(a) for a in (pred_fn, grad_fn, *member, *nonmember))
-        if getattr(self, "_feat_key", None) == key:
+        # the features instead of re-running the model + gradient sweeps.
+        # The cache holds strong references to the inputs and compares
+        # object identity against them, so a recycled id() can never alias
+        # different data (the held objects keep their ids pinned).
+        inputs = (pred_fn, grad_fn, *member, *nonmember)
+        cached = getattr(self, "_feat_inputs", None)
+        if cached is not None and len(cached) == len(inputs) and all(
+                a is b for a, b in zip(cached, inputs)):
             return self._feat_cache
         fm = self._features(pred_fn, grad_fn, *member)
         fn_ = self._features(pred_fn, grad_fn, *nonmember)
         x = jnp.concatenate([fm, fn_])
         y = jnp.concatenate([jnp.ones(len(fm), jnp.int32),
                              jnp.zeros(len(fn_), jnp.int32)])
-        self._feat_key, self._feat_cache = key, (x, y)
+        self._feat_inputs, self._feat_cache = inputs, (x, y)
         return x, y
 
     def fit(self, pred_fn, grad_fn, member, nonmember):
@@ -295,6 +300,10 @@ class GradientVectorAttack:
 
     def score(self, pred_fn, grad_fn, member, nonmember) -> dict[str, float]:
         x, y = self._dataset(pred_fn, grad_fn, member, nonmember)
+        # scoring ends the fit→score fast path; drop the pinned inputs so a
+        # retained attack object doesn't keep whole datasets + model-param
+        # closures alive
+        self._feat_inputs = self._feat_cache = None
         pred = jnp.argmax(self.model.apply(self.variables, x), -1)
         acc = float((pred == y).mean())
         tpr = float(pred[y == 1].mean()) if int((y == 1).sum()) else 0.0
