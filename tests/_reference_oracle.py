@@ -1,0 +1,64 @@
+"""Shared scaffolding for driving the LIVING reference (/root/reference).
+
+Used by test_reference_parity.py and test_reference_parity_cnn.py. The
+2020-era reference imports wandb/torchvision at module scope and uses
+networkx<3 APIs; these stubs let it run in this zero-egress image. Keeping
+them here (one copy) means a stub fix lands in every oracle module at once.
+"""
+
+from __future__ import annotations
+
+import sys
+
+REF = "/root/reference"
+
+
+def setup_reference():
+    """Put the reference on sys.path and install the import stubs."""
+    if REF not in sys.path:
+        sys.path.insert(0, REF)
+
+    if "wandb" not in sys.modules:
+        # the reference imports wandb at module scope (fedavg_api.py:7,
+        # fednova_trainer.py); no wandb in this zero-egress image — stub the
+        # two entry points the imported modules reference
+        import types
+
+        _wandb = types.ModuleType("wandb")
+        _wandb.init = lambda *a, **k: None
+        _wandb.log = lambda *a, **k: None
+        sys.modules["wandb"] = _wandb
+
+    try:  # networkx >= 3 removed to_numpy_matrix; the reference uses it
+        import networkx as _nx
+
+        if not hasattr(_nx, "to_numpy_matrix"):
+            _nx.to_numpy_matrix = _nx.to_numpy_array
+    except ImportError:
+        pass
+
+    if "torchvision" not in sys.modules:
+        # data_preprocessing/utils.py imports torchvision at module scope;
+        # the functions under test never touch it (not in this image)
+        import types
+
+        _tv = types.ModuleType("torchvision")
+        _tv.datasets = types.ModuleType("torchvision.datasets")
+        _tv.transforms = types.ModuleType("torchvision.transforms")
+        sys.modules["torchvision"] = _tv
+        sys.modules["torchvision.datasets"] = _tv.datasets
+        sys.modules["torchvision.transforms"] = _tv.transforms
+
+
+def torch_batches(x, y, batch_size):
+    """Fixed-order list of (x, y) tensors == DataLoader(shuffle=False,
+    drop_last=False)."""
+    import torch
+
+    if batch_size <= 0:
+        batch_size = len(x)
+    return [
+        (torch.from_numpy(x[i:i + batch_size]),
+         torch.from_numpy(y[i:i + batch_size]).long())
+        for i in range(0, len(x), batch_size)
+    ]

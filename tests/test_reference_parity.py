@@ -49,41 +49,9 @@ pytestmark = pytest.mark.slow
 
 torch = pytest.importorskip("torch")
 
-REF = "/root/reference"
-if REF not in sys.path:
-    sys.path.insert(0, REF)
+from _reference_oracle import setup_reference, torch_batches  # noqa: E402
 
-if "wandb" not in sys.modules:
-    # the reference imports wandb at module scope (fedavg_api.py:7,
-    # fednova_trainer.py); no wandb in this zero-egress image — stub the two
-    # entry points the imported modules reference (the oracle never logs)
-    import types
-
-    _wandb = types.ModuleType("wandb")
-    _wandb.init = lambda *a, **k: None
-    _wandb.log = lambda *a, **k: None
-    sys.modules["wandb"] = _wandb
-
-try:  # networkx >= 3 removed to_numpy_matrix; the 2020-era reference uses it
-    import networkx as _nx
-
-    if not hasattr(_nx, "to_numpy_matrix"):
-        _nx.to_numpy_matrix = _nx.to_numpy_array
-except ImportError:
-    pass
-
-if "torchvision" not in sys.modules:
-    # data_preprocessing/utils.py imports torchvision at module scope; the
-    # partition functions under test never touch it (torchvision not in this
-    # image)
-    import types
-
-    _tv = types.ModuleType("torchvision")
-    _tv.datasets = types.ModuleType("torchvision.datasets")
-    _tv.transforms = types.ModuleType("torchvision.transforms")
-    sys.modules["torchvision"] = _tv
-    sys.modules["torchvision.datasets"] = _tv.datasets
-    sys.modules["torchvision.transforms"] = _tv.transforms
+setup_reference()
 
 import flax.linen as nn  # noqa: E402
 import jax  # noqa: E402
@@ -146,15 +114,7 @@ def _jax_variables(w, b):
     return {"params": {"linear": {"kernel": jnp.asarray(w.T), "bias": jnp.asarray(b)}}}
 
 
-def _torch_batches(x, y, batch_size):
-    """Fixed-order list of (x, y) tensors == DataLoader(shuffle=False,
-    drop_last=False)."""
-    if batch_size <= 0:
-        batch_size = len(x)
-    return [
-        (torch.from_numpy(x[i : i + batch_size]), torch.from_numpy(y[i : i + batch_size]).long())
-        for i in range(0, len(x), batch_size)
-    ]
+_torch_batches = torch_batches  # shared scaffolding (tests/_reference_oracle.py)
 
 
 def _ref_params_np(model):
