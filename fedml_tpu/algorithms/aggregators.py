@@ -58,9 +58,19 @@ def make_server_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
         # tests/test_reference_parity.py::test_fedopt_server_parity
         return optax.adam(cfg.server_lr)
     if name == "yogi":
+        # reference "FedYogi" is advertised but NOT runnable: OptRepo scans
+        # torch.optim.Optimizer subclasses and torch ships no Yogi, so
+        # name2cls("yogi") raises KeyError (pinned by
+        # test_reference_parity.py::test_reference_yogi_is_not_instantiable).
+        # optax.yogi implements the Adaptive-Federated-Optimization paper's
+        # Yogi — the rebuild EXCEEDS the reference here.
         return optax.yogi(cfg.server_lr)
     if name == "adagrad":
-        return optax.adagrad(cfg.server_lr)
+        # torch-exact numerics (optax.adagrad differs in accumulator init
+        # AND eps placement); parity: test_fedopt_server_parity[adagrad]
+        from fedml_tpu.algorithms.engine import torch_adagrad
+
+        return torch_adagrad(cfg.server_lr)
     raise ValueError(f"unknown server_optimizer {cfg.server_optimizer!r}")
 
 

@@ -82,6 +82,28 @@ def torch_amsgrad(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e
     return optax.chain(scale_by_torch_amsgrad(b1, b2, eps), optax.scale(-lr))
 
 
+def torch_adagrad(lr: float, eps: float = 1e-10):
+    """torch.optim.Adagrad numerics, exactly: accumulator starts at 0 and
+    eps sits OUTSIDE the sqrt (p -= lr * g / (sqrt(sum) + eps)).
+
+    optax.adagrad differs twice: initial_accumulator_value=0.1 and
+    scale_by_rss's eps inside the rsqrt with a zero-sum guard — ~1e-1
+    relative divergence on early steps (caught by
+    test_reference_parity.py::test_fedopt_server_parity[adagrad])."""
+
+    def init_fn(params):
+        return jax.tree.map(jnp.zeros_like, params)
+
+    def update_fn(updates, state, params=None):
+        del params
+        acc = jax.tree.map(lambda s, g: s + g * g, state, updates)
+        out = jax.tree.map(lambda g, s: -lr * g / (jnp.sqrt(s) + eps),
+                           updates, acc)
+        return out, acc
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_local_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
     """Client optimizer matching reference trainer construction
     (my_model_trainer_classification.py:25-31: SGD(lr) or Adam(lr, wd,

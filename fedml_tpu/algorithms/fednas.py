@@ -35,12 +35,33 @@ class NASState(NamedTuple):
     a_opt: Any
 
 
+def _momentum_buffer(w_opt_state, params):
+    """The weight optimizer's momentum buffer (optax.TraceState inside the
+    chain), or zeros when none has accumulated yet — the reference's
+    try/except moment extraction (architect.py:36-40)."""
+    for s in w_opt_state:
+        if isinstance(s, optax.TraceState):
+            return s.trace
+    return jax.tree.map(jnp.zeros_like, params)
+
+
 def build_search_step(network: DARTSNetwork, cfg: FedConfig,
                       arch_lr: float = 3e-4, arch_wd: float = 1e-3,
                       unrolled: bool = False, w_grad_clip: float = 5.0,
-                      gdas: bool = False, tau: float = 5.0):
+                      gdas: bool = False, tau: float = 5.0,
+                      lambda_train: float = 1.0):
     """One DARTS search step: arch update on the val batch, then weight
     update on the train batch (reference FedNASTrainer.local_search:82).
+
+    ``lambda_train`` is the reference's lambda_train_regularizer: the
+    first-order arch gradient FedNAS actually runs is Architect.step_v2
+    (architect.py:58-100, called at FedNASTrainer.py:103) —
+    g_alpha = grad_alpha(L_val) + lambda_train * grad_alpha(L_train),
+    default 1 (main_fednas.py:91). The reference's lambda_valid_regularizer
+    is accepted but never used by step_v2 (its val-scaling line is commented
+    out), so it has no analog here. lambda_train=0 recovers the classic
+    DARTS first-order step; ``unrolled=True`` replaces the val term with the
+    exact unrolled bi-level gradient.
 
     ``gdas=True`` is the gumbel-softmax search variant (reference
     model_search_gdas.py Network_GumbelSoftmax, tau=5 at :105): every forward
@@ -57,9 +78,15 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
     packed-client padding convention of algorithms/engine.py).
     """
     momentum = cfg.momentum if cfg.momentum else 0.9
+    wd = cfg.wd if cfg.wd else 3e-4
+    # NOTE: the reference's local_search clips the ARCH parameters' grads
+    # after the weight loss.backward() (FedNASTrainer.py:111-113) and then
+    # overwrites those grads in the next step_v2 — its weight step is
+    # effectively unclipped. Clipping the weight grads (as the reference's
+    # own darts/train_search.py:110 does) is the intended behavior kept here.
     w_opt = optax.chain(
-        optax.clip_by_global_norm(w_grad_clip),  # reference clips weights at 5.0
-        optax.add_decayed_weights(cfg.wd if cfg.wd else 3e-4),
+        optax.clip_by_global_norm(w_grad_clip),
+        optax.add_decayed_weights(wd),
         optax.trace(decay=momentum),
         optax.scale(-1.0),  # step() multiplies by the scheduled lr_e
     )
@@ -97,20 +124,40 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
         vmask = jnp.ones(vy.shape, jnp.float32)
         if gdas and grng is None:
             raise ValueError("gdas=True requires a per-step rng")
-        gr_a = gr_w = None
+        gr_a = gr_w = gr_t = None
         if gdas:
-            gr_a, gr_w = jax.random.split(grng)
+            gr_a, gr_w, gr_t = jax.random.split(grng, 3)
 
         # ---- architecture step (on validation data)
         if unrolled:
+            # the unrolled inner step mirrors the reference's virtual weight
+            # update (architect.py:31-43): theta' = theta - eta * (momentum *
+            # buf + grad + wd * theta), with the LIVE momentum buffer from
+            # the weight optimizer state. The outer d/dalpha is exact
+            # autodiff, not the reference's finite-difference hessian-vector
+            # product — the documented deviation.
+            buf = _momentum_buffer(state.w_opt, params)
+
             def val_after_one_weight_step(alphas):
                 g = jax.grad(lambda p: ce(p, alphas, tx, ty, tmask, gr_w)[0])(params)
-                w2 = jax.tree.map(lambda p, gg: p - lr_e * gg, params, g)
+                w2 = jax.tree.map(
+                    lambda p, gg, b: p - lr_e * (momentum * b + gg + wd * p),
+                    params, g, buf)
                 return ce(w2, alphas, vx, vy, vmask, gr_a)[0]
 
             a_grads = jax.grad(val_after_one_weight_step)(alphas)
         else:
             a_grads = jax.grad(lambda a: ce(params, a, vx, vy, vmask, gr_a)[0])(alphas)
+            if lambda_train:
+                # step_v2's train-gradient regularizer (architect.py:63-85);
+                # the unrolled path above is the classic 2nd-order DARTS
+                # objective, which the reference never combines with it.
+                # gr_t: under GDAS each forward draws its own gumbel samples
+                # (reference samples fresh per forward) — reusing gr_a would
+                # correlate the two gradient terms
+                gt = jax.grad(lambda a: ce(params, a, tx, ty, tmask, gr_t)[0])(alphas)
+                a_grads = jax.tree.map(
+                    lambda gv, g: gv + lambda_train * g, a_grads, gt)
         a_upd, a_opt_state = a_opt.update(a_grads, state.a_opt, alphas)
         alphas = optax.apply_updates(alphas, a_upd)
         if val_ok is not None:
@@ -141,7 +188,8 @@ class FedNASAPI:
     def __init__(self, dataset: FederatedDataset, cfg: FedConfig,
                  channels: int = 8, layers: int = 4, arch_lr: float = 3e-4,
                  unrolled: bool = False, lr_min: float = 1e-3,
-                 gdas: bool = False, tau: float = 5.0):
+                 gdas: bool = False, tau: float = 5.0,
+                 lambda_train: float = 1.0):
         self.dataset = dataset
         self.cfg = cfg
         self.network = DARTSNetwork(output_dim=dataset.class_num,
@@ -152,7 +200,7 @@ class FedNASAPI:
         params = self.network.init({"params": rng}, example, an, ar, train=False)["params"]
         step, w_opt, a_opt = build_search_step(self.network, cfg, arch_lr=arch_lr,
                                                unrolled=unrolled, gdas=gdas,
-                                               tau=tau)
+                                               tau=tau, lambda_train=lambda_train)
         self.gdas = gdas
         self.global_state = NASState(params, (an, ar), w_opt.init(params),
                                      a_opt.init((an, ar)))

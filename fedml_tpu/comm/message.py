@@ -4,9 +4,9 @@ The core TPU path has no message envelopes — rounds are jitted functions
 (algorithms/engine.py) — but the mobile/IoT deployment mode keeps the
 reference's wire contract (reference fedml_core/distributed/communication/
 message.py:5-74): a msg_type + sender + receiver header with arbitrary
-JSON-serializable params, arrays encoded as nested lists exactly like the
-reference's `transform_tensor_to_list` (fedavg/utils.py:118) for
-`is_mobile` payloads.
+JSON-serializable params, model params as a flat {name: nested lists} dict
+exactly like the reference's `transform_tensor_to_list` (fedavg/utils.py:
+11-14) for `is_mobile` payloads.
 """
 
 from __future__ import annotations
@@ -19,6 +19,36 @@ import numpy as np
 MSG_ARG_KEY_TYPE = "msg_type"
 MSG_ARG_KEY_SENDER = "sender"
 MSG_ARG_KEY_RECEIVER = "receiver"
+
+
+def _named_leaves(tree: Any) -> list[tuple[str, Any]]:
+    """Deterministic (dotted-path-name, leaf) pairs — the pytree analog of
+    torch state_dict keys ('params.linear.kernel' ≙ 'linear.weight')."""
+    import jax
+
+    def name(path):
+        parts = []
+        for p in path:
+            for attr in ("key", "idx", "name"):
+                if hasattr(p, attr):
+                    parts.append(str(getattr(p, attr)))
+                    break
+            else:
+                parts.append(str(p))
+        return ".".join(parts)
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    named = [(name(path), leaf) for path, leaf in flat]
+    if len({n for n, _ in named}) != len(named):
+        # e.g. a dict key containing '.' colliding with a nested path — the
+        # flat wire dict would silently drop a leaf and decode would
+        # duplicate another; fail loudly instead
+        dupes = sorted({n for i, (n, _) in enumerate(named)
+                        if any(m == n for m, _ in named[:i])})
+        raise ValueError(
+            f"pytree paths collide under dotted naming: {dupes}; rename the "
+            "colliding keys to use the mobile wire format")
+    return named
 
 
 class Message:
@@ -53,22 +83,29 @@ class Message:
         return self.msg_params[MSG_ARG_KEY_RECEIVER]
 
     def add_model_params(self, key: str, tree: Any):
-        """Arrays -> nested lists (the reference's mobile JSON encoding)."""
-        import jax
-
-        leaves, treedef = jax.tree.flatten(tree)
+        """pytree -> flat {dotted-name: nested lists} dict — the EXACT mobile
+        wire FORMAT of the reference's transform_tensor_to_list
+        (fedavg/utils.py:11-14: a state-dict-style dict whose values are
+        .tolist() arrays). Format-level interop is asserted both directions
+        by tests/test_mqtt.py; note the names themselves are framework
+        leaf names ('params.linear.kernel' here vs 'linear.weight' in a
+        torch peer), so cross-FRAMEWORK peers additionally need a name map
+        for their model."""
         self.msg_params[key] = {
-            "leaves": [np.asarray(l).tolist() for l in leaves],
-            "treedef": str(treedef),
+            name: np.asarray(leaf).tolist()
+            for name, leaf in _named_leaves(tree)
         }
 
     @staticmethod
     def decode_model_params(payload: dict, example_tree: Any) -> Any:
-        """Nested lists -> pytree with example_tree's structure/dtypes."""
+        """Flat named-lists dict -> pytree with example_tree's structure and
+        dtypes (the reference decodes with transform_list_to_tensor,
+        fedavg/utils.py:5-8 — same contract, torch-free)."""
         import jax
 
-        leaves = [np.asarray(l, dtype=np.asarray(e).dtype)
-                  for l, e in zip(payload["leaves"], jax.tree.leaves(example_tree))]
+        flat = _named_leaves(example_tree)
+        leaves = [np.asarray(payload[name], dtype=np.asarray(e).dtype)
+                  for name, e in flat]
         return jax.tree.unflatten(jax.tree.structure(example_tree), leaves)
 
     def to_json(self) -> str:

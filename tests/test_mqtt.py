@@ -183,3 +183,96 @@ def test_mqtt_client_reconnects_and_resubscribes():
             c.disconnect()
     finally:
         broker.close()
+
+
+# ---------------------------------------------------------------------------
+# Wire-format interoperability with the LIVING reference (VERDICT r3 #5):
+# messages produced by the actual reference Message.to_json +
+# transform_tensor_to_list drive our client loop through the broker, and our
+# replies parse with the reference decoder — both directions asserted.
+# ---------------------------------------------------------------------------
+
+
+def test_reference_wire_format_interop_both_directions():
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import jax
+    from _reference_oracle import setup_reference
+
+    setup_reference()
+    from fedml_core.distributed.communication.message import Message as RefMessage
+    from fedml_api.distributed.fedavg.utils import (
+        transform_list_to_tensor,
+        transform_tensor_to_list,
+    )
+
+    from fedml_tpu.algorithms.engine import build_local_update
+    from fedml_tpu.comm.message import _named_leaves
+    from fedml_tpu.comm.mqtt_fedavg import MqttFedAvgClientManager
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.registry import create_model
+
+    ds = load_dataset("mnist", client_num_in_total=2, partition_method="homo",
+                      seed=0)
+    cfg = FedConfig(dataset="mnist", model="lr", client_num_in_total=2,
+                    client_num_per_round=1, comm_round=1, batch_size=32,
+                    lr=0.1, shuffle=False)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    gv = trainer.init(jax.random.PRNGKey(cfg.seed),
+                      jnp.asarray(ds.train.x[0][:1]))
+
+    broker = MiniBroker()
+    try:
+        client = MqttFedAvgClientManager(broker.host, broker.port, 1, ds,
+                                         trainer, cfg, gv)
+        got: list[bytes] = []
+        ev = threading.Event()
+        tap = MqttClient(broker.host, broker.port, "tap")
+        tap.subscribe("fedml1", lambda t, p: (got.append(p), ev.set()))
+        time.sleep(0.2)
+
+        # ---- direction 1: REFERENCE-encoded init message -> our client.
+        # The reference mobile server encodes the state dict with
+        # transform_tensor_to_list and ships Message.to_json
+        # (FedAvgServerManager is_mobile path + message.py:60-74).
+        named = {name: torch.from_numpy(np.asarray(leaf).copy())
+                 for name, leaf in _named_leaves(gv)}
+        payload = transform_tensor_to_list(named)
+        ref_msg = RefMessage(type=1, sender_id=0, receiver_id=1)
+        ref_msg.add_params("model_params", payload)
+        ref_msg.add_params("client_idx", "0")
+        wire = ref_msg.to_json().encode()
+
+        pub = MqttClient(broker.host, broker.port, "refserver")
+        pub.publish("fedml0_1", wire)
+
+        assert ev.wait(60), "client never replied to the reference message"
+
+        # ---- direction 2: our client's trained reply parses with the
+        # REFERENCE decoder (init_from_json_string + transform_list_to_tensor)
+        reply = RefMessage()
+        reply.init_from_json_string(got[-1].decode())
+        assert reply.get_type() == 3  # MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        assert reply.get_sender_id() == 1
+        assert reply.get("num_samples") == int(ds.train.counts[0])
+        decoded = transform_list_to_tensor(dict(reply.get("model_params")))
+
+        # the decoded tensors equal the jitted local update our client ran
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0 * 1000 + 1)
+        # jitted like the client's own update so the comparison is exact up
+        # to the JSON float round-trip
+        expect = jax.jit(build_local_update(trainer, cfg))(
+            gv, jnp.asarray(ds.train.x[0]), jnp.asarray(ds.train.y[0]),
+            jnp.int32(ds.train.counts[0]), rng)
+        for name, leaf in _named_leaves(expect.variables):
+            np.testing.assert_allclose(decoded[name].numpy(),
+                                       np.asarray(leaf), atol=1e-6)
+
+        tap.disconnect()
+        pub.disconnect()
+        client.stop()
+    finally:
+        broker.close()
