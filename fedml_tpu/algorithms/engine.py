@@ -343,16 +343,36 @@ def build_eval_fn(trainer) -> Callable:
     return jax.jit(eval_fn)
 
 
-def build_client_eval_fn(trainer) -> Callable:
-    """Per-client eval: vmap over packed client rows [C, n_max, ...]; returns
-    per-client metric sums (reference _local_test_on_all_clients,
-    fedavg_api.py:119-183)."""
+def _vmapped_client_eval(trainer) -> Callable:
+    """(variables, x[C, n_max, ...], y, counts) -> per-client metric arrays;
+    the shared core of both eval builders below (one mask/eval definition so
+    the chunked and resident paths cannot drift apart)."""
 
     def one(variables, x, y, count):
         mask = (jnp.arange(x.shape[0]) < count).astype(jnp.float32)
         return trainer.eval_fn(variables, {"x": x, "y": y, "mask": mask})
 
-    def eval_fn(variables, x, y, counts):
-        return jax.vmap(one, in_axes=(None, 0, 0, 0))(variables, x, y, counts)
+    return jax.vmap(one, in_axes=(None, 0, 0, 0))
+
+
+def build_client_eval_fn(trainer) -> Callable:
+    """Per-client eval: vmap over packed client rows [C, n_max, ...]; returns
+    per-client metric sums (reference _local_test_on_all_clients,
+    fedavg_api.py:119-183)."""
+    return jax.jit(_vmapped_client_eval(trainer))
+
+
+def build_federation_eval_fn(trainer) -> Callable:
+    """Whole-federation eval as ONE jitted program scanning client chunks —
+    the resident-eval path (VERDICT r3 weak #4): with the packed split kept
+    device-resident, a full 3400-client eval is a single dispatch instead of
+    ~54 chunked host->device round trips (each ~1 s through the remote
+    driver tunnel). xs: [num_chunks, chunk, n_max, ...]; returns summed
+    metric scalars."""
+    chunk_fn = _vmapped_client_eval(trainer)
+
+    def eval_fn(variables, xs, ys, counts):
+        m = jax.lax.map(lambda inp: chunk_fn(variables, *inp), (xs, ys, counts))
+        return jax.tree.map(lambda v: v.sum(), m)
 
     return jax.jit(eval_fn)

