@@ -34,12 +34,19 @@ def _mix(stacked, W):
     )
 
 
-def build_gossip_step(trainer, cfg: FedConfig, push_sum: bool = False) -> Callable:
+def build_gossip_step(trainer, cfg: FedConfig, push_sum: bool = False,
+                      mix_fn: Callable | None = None,
+                      mix_fn_T: Callable | None = None) -> Callable:
     """One decentralized iteration over all nodes:
       grads at z_t -> x_{t+1/2} = x_t - lr * grad -> gossip mix -> z_{t+1}.
 
     Matches ClientDSGD.train/update_local_parameters (client_dsgd.py:54-92)
     and ClientPushsum.train (client_pushsum.py:57-110).
+
+    ``mix_fn``/``mix_fn_T`` override the dense `W @ x` einsum with the
+    node-per-device ppermute exchange (parallel/gossip.py) — same math,
+    sharded over a `nodes` mesh axis; when set, the `W` step argument is
+    ignored (the matrix is baked into the exchange).
     """
 
     def per_node_grad(z_vars, batch, rng):
@@ -66,13 +73,17 @@ def build_gossip_step(trainer, cfg: FedConfig, push_sum: bool = False) -> Callab
             # client_pushsum.py:92-97) — the effective mix is W^T, which is
             # column-stochastic w.r.t. the receiver, so omega mass evolves on
             # directed graphs and z = x/omega de-biases the average.
-            x_new = _mix(x_half, W.T)
-            omega_new = W.T @ omega
+            if mix_fn_T is not None:
+                x_new = mix_fn_T(x_half)
+                omega_new = mix_fn_T(omega)
+            else:
+                x_new = _mix(x_half, W.T)
+                omega_new = W.T @ omega
             z_params = jax.tree.map(
                 lambda x: x / omega_new.reshape((-1,) + (1,) * (x.ndim - 1)), x_new
             )
         else:
-            x_new = _mix(x_half, W)
+            x_new = mix_fn(x_half) if mix_fn is not None else _mix(x_half, W)
             omega_new = omega
             z_params = x_new
         z_new = dict(z_vars_stacked)
@@ -99,7 +110,31 @@ class DecentralizedFLAPI:
         self.W = jnp.asarray(topology.mixing_matrix())
         self.n = int(self.W.shape[0])
         self.push_sum = push_sum
-        self.step = build_gossip_step(trainer, cfg, push_sum)
+        mix_fn = mix_fn_T = None
+        if cfg.backend == "shard_map":
+            # node-per-device gossip: models sharded over a `nodes` mesh
+            # axis, edges move via ppermute (parallel/gossip.py) — lifts the
+            # one-chip HBM cap on the stacked node models. Needs one device
+            # per node; otherwise fall back to the dense einsum (loudly).
+            import jax as _jax
+
+            if self.n <= len(_jax.devices()):
+                from fedml_tpu.parallel.gossip import build_sharded_mix
+                from fedml_tpu.parallel.mesh import make_mesh
+
+                self.mesh = make_mesh((self.n,), axis_names=("nodes",))
+                Wnp = np.asarray(self.W)
+                mix_fn = build_sharded_mix(Wnp, self.mesh, "nodes")
+                mix_fn_T = build_sharded_mix(Wnp.T, self.mesh, "nodes")
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "backend='shard_map' wants one device per gossip node "
+                    "(%d nodes > %d devices) — using the dense single-chip "
+                    "W @ x mix instead", self.n, len(_jax.devices()))
+        self.step = build_gossip_step(trainer, cfg, push_sum,
+                                      mix_fn=mix_fn, mix_fn_T=mix_fn_T)
         self.loss_history: list[float] = []
 
     def init_nodes(self, example_input) -> Any:

@@ -251,8 +251,13 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
             return (variables, opt_state, steps), auxs
 
         erngs = jax.random.split(rng, cfg.epochs)
+        # steps starts as count*0 rather than a literal 0 so that under
+        # shard_map the carry is varying-over-the-clients-axis from the
+        # start (it becomes varying through batch_valid inside the scan;
+        # a non-varying init fails jax's check_vma carry typing)
         (variables, opt_state, steps), auxs = jax.lax.scan(
-            epoch_body, (global_variables, opt_state, jnp.int32(0)), erngs
+            epoch_body, (global_variables, opt_state,
+                         (count * 0).astype(jnp.int32)), erngs
         )
         # summed train metrics from the final local epoch (shape [E, nb] -> last epoch)
         metrics = {k: v[-1].sum() for k, v in auxs.items()}

@@ -191,10 +191,7 @@ class FedAvgAPI:
                     idx = np.arange(start, min(start + chunk, num))
                     x, y, counts = packed.select(idx)
                     if len(idx) < chunk:  # pad last chunk: stable jit cache
-                        pad = chunk - len(idx)
-                        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-                        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
-                        counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
+                        x, y, counts = pad_clients(x, y, counts, chunk)
                     m = self.client_eval_fn(
                         self.global_variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts)
                     )
@@ -205,15 +202,23 @@ class FedAvgAPI:
             out[f"{split_name}/Loss"] = sums.get("test_loss", 0.0) / total
         return out
 
-    def _resident_eval_data(self, splits, chunk: int = 64):
+    def _resident_eval_data(self, splits, chunk: int | None = None):
         """Device-resident [nc, chunk, n_max, ...] eval arrays per split,
         built once; None when disabled or over the byte budget."""
         if not self.cfg.resident_eval:
             return None
         if self._resident_cache is not None:
             return self._resident_cache or None  # {} = previously over budget
+        if chunk is None:  # same chunk geometry as the streaming path
+            chunk = min(self.dataset.client_num, 64)
         uniq = {id(p): p for _, p in splits}  # test may alias train
-        total_bytes = sum(p.x.nbytes + p.y.nbytes for p in uniq.values())
+
+        def staged_bytes(p):
+            # what stage() actually device_puts: padded to a chunk multiple
+            ratio = (-(-p.num_clients // chunk) * chunk) / p.num_clients
+            return (p.x.nbytes + p.y.nbytes + p.counts.nbytes) * ratio
+
+        total_bytes = sum(staged_bytes(p) for p in uniq.values())
         if total_bytes > self.cfg.resident_eval_budget:
             log.warning(
                 "resident_eval disabled: packed splits are %.1f GiB > budget "

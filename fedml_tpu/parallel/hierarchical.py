@@ -109,6 +109,11 @@ def build_sharded_hierarchical_round_fn(
         return new_global, out_metrics
 
     def round_fn(global_variables, x, y, counts, rng):
+        # check_vma=False for the same narrow reason as sharded.py: the
+        # replicated outputs flow through in-group all_gathers whose
+        # invariance the Auto-mesh VMA system cannot express; replication is
+        # instead asserted bit-exactly against the vmap hierarchical round
+        # (tests/test_parallel.py + __graft_entry__.dryrun_multichip).
         sharded = jax.shard_map(
             shard_body,
             mesh=mesh,

@@ -69,9 +69,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
             return (o, m, l, kb, vb), None
 
         b, _, h, dd = q.shape
-        o0 = jnp.zeros((b, h, t_local, dd), jnp.float32)
-        m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((b, h, t_local), jnp.float32)
+        # pcast to varying: the online-softmax stats become device-varying
+        # inside the scan (each device sees different K/V blocks); marking
+        # the init values keeps jax's check_vma carry typing satisfied
+        var = lambda a: jax.lax.pcast(a, (axis,), to="varying")
+        o0 = var(jnp.zeros((b, h, t_local, dd), jnp.float32))
+        m0 = var(jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
+        l0 = var(jnp.zeros((b, h, t_local), jnp.float32))
         # n-1 compute+rotate hops in the scan, final block computed outside —
         # no wasted last rotation on the ICI ring
         (o, m, l, kb, vb), _ = jax.lax.scan(
@@ -84,7 +88,6 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
-        check_vma=False,
     )
     return sharded(q, k, v)
 
@@ -118,6 +121,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
-        check_vma=False,
     )
     return sharded(q, k, v)

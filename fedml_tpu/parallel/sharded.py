@@ -67,6 +67,13 @@ def build_sharded_round_fn(
         return new_global, new_state, metrics
 
     def round_fn(global_variables, agg_state, x, y, counts, rng):
+        # check_vma=False is deliberate and NARROW in scope: the outputs are
+        # derived from `all_gather`ed per-client results, which this jax
+        # version's varying-manual-axes system cannot mark as replicated on
+        # an Auto-mode mesh (all_gather(to="reduced") demands Explicit axis
+        # types; probed 2026-07). The replication this flag would verify is
+        # instead asserted STRONGER by tests/test_parallel.py: the sharded
+        # round is bit-identical to the single-chip vmap round.
         sharded = jax.shard_map(
             shard_body,
             mesh=mesh,
