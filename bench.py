@@ -27,6 +27,10 @@ WORKLOADS = {
     # name: (model, output_dim, input_shape, samples/client, batch, clients)
     "flagship": ("cnn", 62, (28, 28, 1), 200, 20, 10),
     "cross_silo": ("resnet56", 10, (32, 32, 3), 256, 64, 10),
+    # TPU-tuned variant: space-to-depth input (models/resnet.py resnet56_s2d)
+    # — 3.7x cross_silo's samples/s/chip (docs/PERF.md ladder); a model
+    # variant, so accuracy targets need re-validation before comparisons
+    "cross_silo_s2d": ("resnet56_s2d", 10, (32, 32, 3), 256, 64, 10),
     "cross_silo_mobilenet": ("mobilenet", 10, (32, 32, 3), 256, 64, 10),
     # BASELINE.md's published cross-silo config is E=20, bs 64, 5000
     # samples/silo (CIFAR/10 silos) — run either cross_silo* workload with
@@ -172,6 +176,7 @@ def main():
     metric_name = {
         "flagship": "fedavg_femnist_cnn_samples_per_sec_per_chip",
         "cross_silo": "fedavg_cifar_resnet56_samples_per_sec_per_chip",
+        "cross_silo_s2d": "fedavg_cifar_resnet56_s2d_samples_per_sec_per_chip",
         "cross_silo_mobilenet": "fedavg_cifar_mobilenet_samples_per_sec_per_chip",
     }[workload]
     print(json.dumps({

@@ -26,6 +26,18 @@ print(f"OK {key}={v}")
 EOF
 }
 
+assert_summary_str () {  # assert_summary_str <key> <required-substring>
+  python - "$RUN_DIR" "$1" "$2" <<'EOF'
+import json, sys
+run_dir, key, sub = sys.argv[1], sys.argv[2], sys.argv[3]
+with open(f"{run_dir}/wandb-summary.json") as f:
+    s = json.load(f)
+v = s[key]
+assert isinstance(v, str) and sub in v, f"{key}={v!r} lacks {sub!r}"
+print(f"OK {key} contains {sub!r}")
+EOF
+}
+
 COMMON="--run_dir $RUN_DIR --data_dir ./data --seed 0"
 
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
@@ -123,6 +135,21 @@ python -m fedml_tpu.experiments.main_turboaggregate $COMMON --dataset mnist --mo
   --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 \
   --epochs 1 --batch_size 4 --num_groups 2 --partition_method homo
 assert_summary "Test/Acc" 0.0 1.0
+
+echo "== fednas (tiny DARTS search, 1 round; reference CI-script-fednas.sh)"
+python -m fedml_tpu.experiments.main_fednas $COMMON --dataset cifar10 --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 --epochs 1 \
+  --batch_size 8 --init_channels 4 --layers 1 --steps 2 --multiplier 2
+assert_summary "search_acc" 0.0 1.0
+assert_summary_str "genotype" "Genotype(normal="
+
+echo "== privacy (2-branch predavg ensemble + MI attack report)"
+python -m fedml_tpu.experiments.main_privacy --run_dir "$RUN_DIR" --dataset mnist \
+  --partition_method homo --client_num_in_total 8 --client_num_per_round 4 \
+  --comm_round 1 --epochs 1 --batch_size 32 --lr 0.1 \
+  --branch_num 2 --ensemble_method predavg
+assert_summary "Ensemble/Acc" 0.0 1.0
+assert_summary "MI/NN_attack_acc" 0.0 1.0
 
 echo "== fedseg"
 python -m fedml_tpu.experiments.main_fedseg $COMMON --comm_round 1 --epochs 1 \

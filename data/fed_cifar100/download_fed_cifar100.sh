@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# reference-convention wrapper (see data/README.md); artifact list + manifest
+# logic live in fedml_tpu/data/acquire.py
+cd "$(dirname "$0")/../.."
+python -m fedml_tpu.data.acquire fetch fed_cifar100 --data_dir ./data "$@"

@@ -27,6 +27,7 @@ from fedml_tpu.algorithms.engine import (
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.packing import pack_eval_batches, pad_clients
 from fedml_tpu.data.registry import FederatedDataset
+from fedml_tpu.utils.checkpoint import Checkpointable
 
 log = logging.getLogger(__name__)
 
@@ -42,7 +43,7 @@ def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_rou
     return rng.choice(client_num_in_total, num, replace=False)
 
 
-class FedAvgAPI:
+class FedAvgAPI(Checkpointable):
     """Single-controller federated simulator.
 
     `aggregator_name` swaps the server rule (fedavg/fedopt/robust/fednova)
@@ -126,32 +127,19 @@ class FedAvgAPI:
             self.save_checkpoint(ckpt_dir, cfg.comm_round)
         return self.history
 
-    # ----------------------------------------------------------- checkpoints
-    def save_checkpoint(self, ckpt_dir: str, step: int):
-        """Persist global model + aggregator state + history (SURVEY §5:
-        the reference's core FedAvg cannot resume; this can)."""
-        from fedml_tpu.utils.checkpoint import save_checkpoint
+    # -- checkpoint state (utils.checkpoint.Checkpointable): global model +
+    # aggregator state + history (SURVEY §5: the reference's core FedAvg
+    # cannot resume; this can)
+    def _ckpt_tree(self):
+        return {"variables": self.global_variables, "agg_state": self.agg_state}
 
-        save_checkpoint(ckpt_dir, step, {
-            "tree": {"variables": self.global_variables, "agg_state": self.agg_state},
-            "meta": {"history": self.history},
-        })
+    def _ckpt_meta(self):
+        return {"history": self.history}
 
-    def maybe_restore(self, ckpt_dir: str) -> int:
-        """Restore the latest checkpoint if present; returns the next round."""
-        from fedml_tpu.utils.checkpoint import restore_checkpoint
-
-        out = restore_checkpoint(
-            ckpt_dir, {"variables": self.global_variables, "agg_state": self.agg_state}
-        )
-        if out is None:
-            return 0
-        tree, step, meta = out
+    def _ckpt_load(self, tree, meta):
         self.global_variables = tree["variables"]
         self.agg_state = tree["agg_state"]
         self.history = list(meta.get("history", []))
-        log.info("restored checkpoint at round %d from %s", step, ckpt_dir)
-        return step
 
     # ------------------------------------------------------------------- eval
     def test_global(self, round_idx: int) -> dict[str, float]:

@@ -39,6 +39,7 @@ import optax
 from fedml_tpu.algorithms.engine import torch_amsgrad
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
+from fedml_tpu.utils.checkpoint import Checkpointable
 from fedml_tpu.utils.pytree import tree_where
 
 
@@ -104,7 +105,7 @@ def _epoch_batches(x, y, extra, count, b, rng):
     return xe, ye, ee, bvalid.reshape(nb, b)
 
 
-class FedGKTAPI:
+class FedGKTAPI(Checkpointable):
     """Alternating edge/server knowledge transfer (reference FedGKTAPI.py:16).
 
     client_module(x) -> (logits, features); server_module(features) -> logits.
@@ -163,6 +164,7 @@ class FedGKTAPI:
         self._build()
         self.history: list[dict[str, Any]] = []
         self.server_loss_history: list[float] = []  # per-epoch server losses
+        self.server_logits = None  # [C, n_max, classes] once train() starts
 
     def _batch_size(self, n_max: int) -> int:
         b = self.cfg.batch_size
@@ -323,18 +325,56 @@ class FedGKTAPI:
         self.server_loss_history.extend(np.asarray(epoch_losses).tolist())
         return server_logits
 
-    def train(self) -> list[dict[str, Any]]:
+    def train(self, ckpt_dir: str | None = None,
+              ckpt_every: int = 25) -> list[dict[str, Any]]:
+        """Alternating KT rounds with optional mid-run checkpoint/resume.
+
+        The resumable state is everything a round consumes: per-client model
+        + optimizer states, server model + its PERSISTENT optimizer state,
+        and the server logits (round r's client KD targets come from round
+        r-1's server phase) — an interruption loses nothing (asserted by
+        tests/test_split_vfl_secure.py::test_fedgkt_checkpoint_resume_exact)."""
         ds, cfg = self.dataset, self.cfg
         x = jnp.asarray(ds.train.x)
         y = jnp.asarray(ds.train.y)
         counts = jnp.asarray(ds.train.counts)
         mask = (jnp.arange(ds.train.n_max)[None, :] < counts[:, None]).astype(jnp.float32)
-        server_logits = jnp.zeros((ds.client_num, ds.train.n_max, ds.class_num))
+        if self.server_logits is None:
+            self.server_logits = jnp.zeros(
+                (ds.client_num, ds.train.n_max, ds.class_num))
         key = jax.random.PRNGKey(cfg.seed)
-        for r in range(cfg.comm_round):
-            server_logits = self.train_one_round(r, x, y, counts, mask, server_logits, key)
+        start = self.maybe_restore(ckpt_dir) if ckpt_dir else 0
+        for r in range(start, cfg.comm_round):
+            self.server_logits = self.train_one_round(
+                r, x, y, counts, mask, self.server_logits, key)
             self.history.append({"round": r, **self.evaluate()})
+            if ckpt_dir and (r + 1) % ckpt_every == 0:
+                self.save_checkpoint(ckpt_dir, r + 1)
+        if ckpt_dir:
+            self.save_checkpoint(ckpt_dir, cfg.comm_round)
         return self.history
+
+    # -- checkpoint state (utils.checkpoint.Checkpointable): everything a
+    # round consumes, incl. the persistent server optimizer + KD targets
+    def _ckpt_tree(self):
+        return {
+            "client_vars": self.client_vars,
+            "client_opt_states": self.client_opt_states,
+            "server_vars": self.server_vars,
+            "server_opt_state": self.server_opt_state,
+            "server_logits": self.server_logits,
+        }
+
+    def _ckpt_meta(self):
+        return {"history": self.history,
+                "server_loss_history": self.server_loss_history}
+
+    def _ckpt_load(self, tree, meta):
+        for name in ("client_vars", "client_opt_states", "server_vars",
+                     "server_opt_state", "server_logits"):
+            setattr(self, name, tree[name])
+        self.history = list(meta.get("history", []))
+        self.server_loss_history = list(meta.get("server_loss_history", []))
 
     def evaluate(self) -> dict[str, float]:
         """Edge->server composed eval on the global test set (reference

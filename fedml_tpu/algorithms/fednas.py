@@ -25,6 +25,7 @@ import optax
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import FederatedDataset
 from fedml_tpu.models.darts import DARTSNetwork, init_alphas, parse_genotype
+from fedml_tpu.utils.checkpoint import Checkpointable
 from fedml_tpu.utils.pytree import tree_weighted_mean, tree_where
 
 
@@ -180,7 +181,7 @@ def build_search_step(network: DARTSNetwork, cfg: FedConfig,
     return step, w_opt, a_opt
 
 
-class FedNASAPI:
+class FedNASAPI(Checkpointable):
     """Federated DARTS search (reference FedNASAPI.py): each round, sampled
     clients run local bi-level search; the server sample-weight-averages both
     weights and alphas and records the global genotype."""
@@ -189,13 +190,16 @@ class FedNASAPI:
                  channels: int = 8, layers: int = 4, arch_lr: float = 3e-4,
                  unrolled: bool = False, lr_min: float = 1e-3,
                  gdas: bool = False, tau: float = 5.0,
-                 lambda_train: float = 1.0):
+                 lambda_train: float = 1.0,
+                 steps: int = 4, multiplier: int = 4):
         self.dataset = dataset
         self.cfg = cfg
+        self.steps, self.multiplier = steps, multiplier
         self.network = DARTSNetwork(output_dim=dataset.class_num,
-                                    channels=channels, layers=layers)
+                                    channels=channels, layers=layers,
+                                    steps=steps, multiplier=multiplier)
         rng = jax.random.PRNGKey(cfg.seed)
-        an, ar = init_alphas(jax.random.fold_in(rng, 1))
+        an, ar = init_alphas(jax.random.fold_in(rng, 1), steps=steps)
         example = jnp.asarray(dataset.train.x[:1, 0])
         params = self.network.init({"params": rng}, example, an, ar, train=False)["params"]
         step, w_opt, a_opt = build_search_step(self.network, cfg, arch_lr=arch_lr,
@@ -299,19 +303,52 @@ class FedNASAPI:
         self.global_state, metrics = self.round_fn(
             self.global_state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), rng
         )
-        geno = parse_genotype(*self.global_state.alphas)
+        geno = parse_genotype(*self.global_state.alphas, steps=self.steps,
+                              multiplier=self.multiplier)
         self.genotype_history.append(geno)
         return {"search_loss": float(metrics["search_loss"]),
                 "search_acc": float(metrics["search_acc"]),
                 "search_samples": int(metrics["search_samples"]),
                 "genotype": geno}
 
-    def train(self):
-        for r in range(self.cfg.comm_round):
+    def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25):
+        """Search loop with optional mid-run checkpoint/resume — NAS search is
+        the most expensive run in the zoo; the reference only logs genotypes
+        per round (FedNASAggregator.py:173) and cannot resume."""
+        start = self.maybe_restore(ckpt_dir) if ckpt_dir else 0
+        for r in range(start, self.cfg.comm_round):
             rec = self.train_one_round(r)
             self.history.append({"round": r, "search_loss": rec["search_loss"],
                                  "search_acc": rec["search_acc"]})
+            if ckpt_dir and (r + 1) % ckpt_every == 0:
+                self.save_checkpoint(ckpt_dir, r + 1)
+        if ckpt_dir:
+            self.save_checkpoint(ckpt_dir, self.cfg.comm_round)
         return self.history
+
+    # -- checkpoint state (utils.checkpoint.Checkpointable): weights + alphas
+    # + BOTH optimizer states + genotype/metric history — an interrupted
+    # search resumes exactly (test_fednas_checkpoint_resume_exact)
+    def _ckpt_tree(self):
+        return {"state": tuple(self.global_state)}
+
+    def _ckpt_meta(self):
+        return {"history": self.history,
+                "genotype_history": self.genotype_history}
+
+    def _ckpt_load(self, tree, meta):
+        self.global_state = NASState(*tree["state"])
+        self.history = list(meta.get("history", []))
+        # JSON flattens Genotype namedtuples to nested lists — rebuild them
+        # so str()/attribute consumers (main_fednas's wandb genotype record,
+        # ci_smoke's assert) see the same type as a live run
+        from fedml_tpu.models.darts import Genotype
+
+        self.genotype_history = [
+            Genotype(normal=[tuple(e) for e in g[0]], normal_concat=list(g[1]),
+                     reduce=[tuple(e) for e in g[2]], reduce_concat=list(g[3]))
+            for g in meta.get("genotype_history", [])
+        ]
 
     def evaluate(self, batch_size: int = 256) -> dict[str, float]:
         """Full-test-set accuracy, batched (reference FedNASAggregator.infer

@@ -53,7 +53,7 @@ def main(argv=None):
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
     api = FedGKTAPI(ds, cfg, client, server, alpha=args.alpha,
                     temperature=args.temperature, server_epochs=args.epochs_server)
-    history = api.train()
+    history = api.train(ckpt_dir=args.ckpt_dir)
     final = api.evaluate()
     for r, rec in enumerate(history):
         logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)

@@ -13,6 +13,10 @@ def main(argv=None):
     parser = add_args(argparse.ArgumentParser())
     parser.add_argument("--init_channels", type=int, default=8)
     parser.add_argument("--layers", type=int, default=4)
+    # cell size (reference model_search.py Network(steps, multiplier));
+    # steps 2 / multiplier 2 gives a genuinely tiny CI-smokeable search net
+    parser.add_argument("--steps", type=int, default=4)
+    parser.add_argument("--multiplier", type=int, default=4)
     parser.add_argument("--arch_lr", type=float, default=3e-4)
     parser.add_argument("--unrolled", type=int, default=0)
     # GDAS variant (reference model_search_gdas.py): hard gumbel-softmax
@@ -24,10 +28,12 @@ def main(argv=None):
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
     api = FedNASAPI(ds, cfg, channels=args.init_channels, layers=args.layers,
                     arch_lr=args.arch_lr, unrolled=bool(args.unrolled),
-                    gdas=bool(args.gdas), tau=args.tau)
-    history = api.train()
+                    gdas=bool(args.gdas), tau=args.tau, steps=args.steps,
+                    multiplier=args.multiplier)
+    history = api.train(ckpt_dir=args.ckpt_dir)
     for rec in history:
-        logger.log({"search_loss": rec["search_loss"]}, step=rec["round"])
+        logger.log({"search_loss": rec["search_loss"],
+                    "search_acc": rec["search_acc"]}, step=rec["round"])
     # reference records the genotype each round (FedNASAggregator.py:173)
     logger.log({"genotype": str(api.genotype_history[-1])})
     logger.finish()

@@ -76,18 +76,34 @@ class Bottleneck(nn.Module):
 
 
 class ResNetCifar(nn.Module):
-    """3-stage CIFAR ResNet: stem 3x3 conv 16 -> stages 16/32/64 -> gap -> fc."""
+    """3-stage CIFAR ResNet: stem 3x3 conv 16 -> stages 16/32/64 -> gap -> fc.
+
+    TPU-tuning knobs (defaults = exact reference architecture):
+      ``widths``  stage channel widths — CIFAR's 16-64 channels fill at most
+                  half the MXU's 128 lanes; the cross-silo MFU ladder
+                  (tools/bench_cross_silo.py, docs/PERF.md) measures what
+                  wider stages buy.
+      ``s2d``     space-to-depth 2x2 on the input (32x32x3 -> 16x16x12), the
+                  standard small-image transform that quarters the spatial
+                  extent the narrow early stages are dragged across.
+    """
 
     block: Type[nn.Module]
     layers: Sequence[int]
     output_dim: int = 10
     group_norm: int = 0
+    widths: Sequence[int] = (16, 32, 64)
+    s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        if self.s2d:
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        x = nn.Conv(self.widths[0], (3, 3), padding=1, use_bias=False, name="conv1")(x)
         x = nn.relu(_Norm(self.group_norm)(x, train))
-        for stage, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
+        for stage, (planes, blocks) in enumerate(zip(self.widths, self.layers)):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
                 x = self.block(planes=planes, stride=stride, group_norm=self.group_norm)(x, train)
@@ -131,8 +147,17 @@ def resnet44(output_dim=10, group_norm=0):
     return ResNetCifar(block=BasicBlock, layers=(7, 7, 7), output_dim=output_dim, group_norm=group_norm)
 
 
-def resnet56(output_dim=10, group_norm=0):
-    return ResNetCifar(block=Bottleneck, layers=(6, 6, 6), output_dim=output_dim, group_norm=group_norm)
+def resnet56(output_dim=10, group_norm=0, s2d=False):
+    return ResNetCifar(block=Bottleneck, layers=(6, 6, 6), output_dim=output_dim,
+                       group_norm=group_norm, s2d=s2d)
+
+
+def resnet56_s2d(output_dim=10, group_norm=0):
+    """ResNet-56 with space-to-depth input — the TPU-tuned cross-silo
+    variant: 3.7x the baseline's samples/s/chip at the bench config
+    (docs/PERF.md cross-silo ladder). An architecture variant, not the
+    reference model — accuracy must be re-validated per task."""
+    return resnet56(output_dim=output_dim, group_norm=group_norm, s2d=True)
 
 
 def resnet110(output_dim=10, group_norm=0):

@@ -46,8 +46,8 @@ def _har_cnn(output_dim, **kw):
 
 
 # CIFAR ResNets (reference resnet.py:218,241 / resnet_cifar.py) ---------------
-for _name in ("resnet20", "resnet32", "resnet44", "resnet56", "resnet110",
-              "resnet18", "resnet34", "resnet50"):
+for _name in ("resnet20", "resnet32", "resnet44", "resnet56", "resnet56_s2d",
+              "resnet110", "resnet18", "resnet34", "resnet50"):
     def _make(output_dim, _f=getattr(_resnet, _name), **kw):
         return _f(output_dim=output_dim, group_norm=kw.get("group_norm", 0))
 

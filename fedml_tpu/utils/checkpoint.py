@@ -9,6 +9,7 @@ history) checkpoints atomically every N rounds and restores exactly.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any
 
@@ -97,3 +98,33 @@ def restore_checkpoint(ckpt_dir: str, example_tree, step: int | None = None):
         ckptr = ocp.StandardCheckpointer()
         tree = ckptr.restore(os.path.abspath(path), _to_numpy(example_tree))
     return tree, step, meta.get("meta", {})
+
+
+class Checkpointable:
+    """Shared save/restore scaffolding for algorithm APIs.
+
+    Implementors provide the three genuinely algorithm-specific pieces:
+      _ckpt_tree()          -> pytree-of-arrays run state (also the restore
+                               structure/dtype example)
+      _ckpt_meta()          -> JSON-serializable metadata dict
+      _ckpt_load(tree, meta)   install restored state onto self
+
+    One copy of the orchestration means backend/atomicity/retention changes
+    reach every algorithm at once (FedAvg, FedNAS, FedGKT, FedSeg...).
+    """
+
+    def save_checkpoint(self, ckpt_dir: str, step: int):
+        save_checkpoint(ckpt_dir, step,
+                        {"tree": self._ckpt_tree(), "meta": self._ckpt_meta()})
+
+    def maybe_restore(self, ckpt_dir: str) -> int:
+        """Restore the latest checkpoint if present; returns the next round."""
+        out = restore_checkpoint(ckpt_dir, self._ckpt_tree())
+        if out is None:
+            return 0
+        tree, step, meta = out
+        self._ckpt_load(tree, meta)
+        logging.getLogger(__name__).info(
+            "restored %s checkpoint at round %d from %s",
+            type(self).__name__, step, ckpt_dir)
+        return step

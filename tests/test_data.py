@@ -84,3 +84,57 @@ def test_rnn_nwp_end_to_end():
     hist = api.train()
     assert np.isfinite(hist[-1]["Test/Loss"])
     assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+
+
+# ------------------------------------------------------- acquisition tooling
+
+
+def test_acquire_dry_run_lists_reference_urls(capsys):
+    from fedml_tpu.data.acquire import main
+
+    assert main(["fetch", "femnist", "--dry_run"]) == 0
+    out = capsys.readouterr().out
+    assert "fed_emnist.tar.bz2" in out and "https://" in out
+
+
+def test_acquire_verify_detects_corruption(tmp_path, capsys):
+    import json
+
+    from fedml_tpu.data import acquire
+
+    # forge a "downloaded" file + manifest, then corrupt the file
+    d = tmp_path / "data"
+    (d / "MNIST" / "raw").mkdir(parents=True)
+    f = d / "MNIST" / "raw" / "train-images-idx3-ubyte.gz"
+    f.write_bytes(b"payload")
+    manifest = {"MNIST/raw/train-images-idx3-ubyte.gz":
+                {"sha256": acquire._sha256(str(f)), "bytes": 7}}
+    (d / f"mnist.{acquire.MANIFEST}").write_text(json.dumps(manifest))
+
+    assert acquire.verify("mnist", str(d)) == 0
+    f.write_bytes(b"tampered")
+    assert acquire.verify("mnist", str(d)) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out
+    f.unlink()
+    assert acquire.verify("mnist", str(d)) == 1
+    assert acquire.verify("nonexistent", str(d)) == 2  # no manifest
+
+
+def test_acquire_stats_runs_on_surrogate(capsys):
+    from fedml_tpu.data.acquire import main
+
+    assert main(["stats", "mnist", "--clients", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "clients: 4" in out and "class histogram" in out
+
+
+def test_download_wrappers_exist_and_call_acquire():
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1] / "data"
+    wrappers = list(root.glob("*/download_*.sh"))
+    assert len(wrappers) >= 6
+    for w in wrappers:
+        text = w.read_text()
+        assert "fedml_tpu.data.acquire fetch" in text

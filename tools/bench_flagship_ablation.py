@@ -1,0 +1,120 @@
+"""Flagship overhead ablation — finish the r3 measurement ladder (weak #2).
+
+r3 established: minimal hand loop 170k samples/s/chip vs engine 146.5k, with
+masking proven free (assume_full_clients) — leaving ~12% attributed to
+"metrics + aggregation" WITHOUT an ablation. This script runs the missing
+rungs, each a 20-round jitted scan at the flagship config (CNN_DropOut,
+10x200 samples, bs 20, bf16):
+
+  engine_full          build_multi_round_fn as benched (the 146.5k config)
+  no_metrics           identical loop, per-round metric accumulation dropped
+  identity_agg         weighted-mean aggregation replaced by a client-0
+                       select (keeps the loop shape, removes the tree math)
+  no_metrics_no_agg    both — the engine skeleton alone
+
+Run on the real TPU: python tools/bench_flagship_ablation.py
+Appends the table to docs/cross_silo_ladder.json's sibling
+docs/flagship_ablation.json and prints one JSON line per rung.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.aggregators import make_aggregator
+from fedml_tpu.algorithms.engine import build_local_update, build_multi_round_fn
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.models.registry import create_model
+
+CPR, N, BS, R = 10, 200, 20, 20
+
+
+def _variant_multi_round(trainer, cfg, num_rounds, metrics_on, real_agg, agg):
+    """The build_multi_round_fn loop with metric/aggregation rungs toggled —
+    a measurement harness mirror of engine.build_multi_round_fn (full
+    participation path; kept here, not in the engine, because these are
+    ablations, not product modes)."""
+    local_update = build_local_update(trainer, cfg)
+
+    def multi_round(global_variables, agg_state, x, y, counts, base_rng):
+        def body(carry, round_idx):
+            gv, st = carry
+            rng = jax.random.fold_in(base_rng, round_idx)
+            crngs = jax.random.split(rng, x.shape[0])
+            result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+                gv, x, y, counts, crngs)
+            if real_agg:
+                gv, st = agg(gv, result, counts.astype(jnp.float32), rng, st)
+            else:
+                gv = jax.tree.map(lambda l: l[0], result.variables)
+            metrics = ({k: v.sum() for k, v in result.metrics.items()}
+                       if metrics_on else {})
+            return (gv, st), metrics
+
+        (gv, st), metrics = jax.lax.scan(
+            body, (global_variables, agg_state), jnp.arange(num_rounds))
+        return gv, st, metrics
+
+    return jax.jit(multi_round)
+
+
+def _time(fn, args, reps=3):
+    gv, st, _ = fn(*args)
+    float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        gv, st, _ = fn(*args)
+        float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    print(f"# devices: {jax.devices()}")
+    cfg = FedConfig(batch_size=BS, epochs=1, lr=0.1, client_optimizer="sgd",
+                    client_num_per_round=CPR, dtype="bfloat16",
+                    assume_full_clients=True)
+    trainer = ClassificationTrainer(create_model("cnn", output_dim=62,
+                                                 dtype="bfloat16"))
+    agg = make_aggregator("fedavg", cfg)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(CPR, N, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 62, size=(CPR, N)).astype(np.int32))
+    counts = jnp.full((CPR,), N, jnp.int32)
+    gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
+    st = agg.init_state(gv)
+    key = jax.random.PRNGKey(1)
+    args = (gv, st, x, y, counts, key)
+
+    out = []
+
+    def rung(name, fn):
+        dt = _time(fn, args)
+        sps = R * CPR * N / dt
+        rec = {"rung": name, "samples_per_sec_per_chip": round(sps, 1),
+               "scan20_time_s": round(dt, 4)}
+        print(json.dumps(rec))
+        out.append(rec)
+
+    rung("engine_full", build_multi_round_fn(trainer, cfg, agg, R))
+    rung("no_metrics", _variant_multi_round(trainer, cfg, R, False, True, agg))
+    rung("identity_agg", _variant_multi_round(trainer, cfg, R, True, False, agg))
+    rung("no_metrics_no_agg",
+         _variant_multi_round(trainer, cfg, R, False, False, agg))
+
+    with open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                           "flagship_ablation.json"), "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
