@@ -225,7 +225,14 @@ class FedSegAPI:
 
     def train(self, ckpt_dir: str | None = None, metrics_logger=None):
         cfg = self.cfg
-        for r in range(cfg.comm_round):
+        start = 0
+        if ckpt_dir:
+            # resume via the inner FedAvg state (model + aggregator); eval
+            # history rides the checkpoint metadata
+            start = self._inner.maybe_restore(ckpt_dir)
+            self.history = list(self._inner.history)
+            self._inner.history = []
+        for r in range(start, cfg.comm_round):
             m = self._inner.train_one_round(r)
             rec = {"round": r, **{k: float(v) for k, v in m.items()}}
             if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
@@ -235,6 +242,7 @@ class FedSegAPI:
             if metrics_logger is not None:
                 metrics_logger.log({k: v for k, v in rec.items() if k != "round"}, step=r)
             if ckpt_dir:
+                self._inner.history = self.history  # persist OUR eval records
                 self._inner.save_checkpoint(ckpt_dir, r + 1)
         return self.history
 

@@ -35,6 +35,7 @@ def test_cnn_dropout_param_count():
 @pytest.mark.parametrize("name,inp,out_dim", [
     ("resnet20", (2, 32, 32, 3), 10),
     ("resnet56", (2, 32, 32, 3), 10),
+    ("resnet56_s2d", (2, 32, 32, 3), 10),  # TPU-tuned cross-silo variant
     ("mobilenet", (2, 32, 32, 3), 100),
     ("vgg11", (2, 32, 32, 3), 10),
     ("har_cnn", (2, 128, 9), 6),
@@ -120,3 +121,26 @@ def test_new_cv_models_forward(name, kw):
     v, out = _init_and_apply(m, jnp.zeros((2, 32, 32, 3)))
     assert out.shape == (2, 10)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_resnet56_s2d_differs_only_in_stem_geometry():
+    """The s2d variant keeps the reference trunk (same stage widths/blocks;
+    only conv1's input channels change 3 -> 12 and spatial extents halve) —
+    it is the documented TPU-tuned bench variant, not a silent swap of the
+    reference resnet56 (which must stay exact-parity)."""
+    import jax
+
+    base = create_model("resnet56", output_dim=10)
+    s2d = create_model("resnet56_s2d", output_dim=10)
+    vb, _ = _init_and_apply(base, jnp.zeros((1, 32, 32, 3)))
+    vs, _ = _init_and_apply(s2d, jnp.zeros((1, 32, 32, 3)))
+    pb, ps = vb["params"], vs["params"]
+    assert pb["conv1"]["kernel"].shape == (3, 3, 3, 16)
+    assert ps["conv1"]["kernel"].shape == (3, 3, 12, 16)
+    # every non-stem layer has identical shapes
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(pb)[0])
+    flat_s = dict(jax.tree_util.tree_flatten_with_path(ps)[0])
+    assert flat_b.keys() == flat_s.keys()
+    diff = [k for k in flat_b
+            if flat_b[k].shape != flat_s[k].shape]
+    assert len(diff) == 1  # only conv1's kernel
