@@ -928,3 +928,17 @@ def test_neural_vfl_trajectory_parity():
         np.testing.assert_allclose(np.asarray(params[k]["dense_w"]), ref_dw[k],
                                    rtol=1e-4, atol=1e-6,
                                    err_msg=f"party {k} dense_w")
+
+
+def test_reference_hierarchical_fl_is_broken():
+    """Pin why hierarchical FL has no living-reference trajectory oracle:
+    the fork's standalone/hierarchical_fl imports
+    fedml_api.standalone.fedavg.fedavg_trainer (trainer.py:6, group.py:4),
+    which does not exist — the reference implementation cannot even be
+    imported (SURVEY §2.3 'Broken in this fork'). The rebuild's
+    hierarchical path is instead validated by the reference CI's own
+    equivalence oracle (hierarchical == flat FedAvg when global x group
+    rounds are fixed, CI-script-fedavg.sh:52-62) in
+    tests/test_algorithms.py::test_hierarchical_oracle_equals_flat_fedavg."""
+    with pytest.raises(ModuleNotFoundError, match="fedavg_trainer"):
+        import fedml_api.standalone.hierarchical_fl.trainer  # noqa: F401
