@@ -340,8 +340,7 @@ class FedGKTAPI(Checkpointable):
         counts = jnp.asarray(ds.train.counts)
         mask = (jnp.arange(ds.train.n_max)[None, :] < counts[:, None]).astype(jnp.float32)
         if self.server_logits is None:
-            self.server_logits = jnp.zeros(
-                (ds.client_num, ds.train.n_max, ds.class_num))
+            self.server_logits = self._init_server_logits()
         key = jax.random.PRNGKey(cfg.seed)
         start = self.maybe_restore(ckpt_dir) if ckpt_dir else 0
         for r in range(start, cfg.comm_round):
@@ -356,7 +355,15 @@ class FedGKTAPI(Checkpointable):
 
     # -- checkpoint state (utils.checkpoint.Checkpointable): everything a
     # round consumes, incl. the persistent server optimizer + KD targets
+    def _init_server_logits(self):
+        ds = self.dataset
+        return jnp.zeros((ds.client_num, ds.train.n_max, ds.class_num))
+
     def _ckpt_tree(self):
+        if self.server_logits is None:
+            # direct maybe_restore() before train(): the example tree must
+            # have the trained tree's structure, not a None leaf
+            self.server_logits = self._init_server_logits()
         return {
             "client_vars": self.client_vars,
             "client_opt_states": self.client_opt_states,

@@ -121,6 +121,22 @@ def _manifest_path(data_dir: str, dataset: str) -> str:
     return os.path.join(data_dir, f"{dataset}.{MANIFEST}")
 
 
+def _looks_like_html(path: str) -> bool:
+    with open(path, "rb") as f:
+        head = f.read(512).lstrip().lower()
+    return head.startswith(b"<!doctype html") or head.startswith(b"<html")
+
+
+def _gdrive_confirm_token(html_path: str) -> str:
+    """Pull the confirm token out of the interstitial page; 't' (the
+    modern accept-anyway value) when the page carries none."""
+    import re
+
+    with open(html_path, "rb") as f:
+        m = re.search(rb"confirm=([0-9A-Za-z_-]+)", f.read())
+    return m.group(1).decode() if m else "t"
+
+
 def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
     """Download the dataset's artifacts and record their sha256 manifest.
     --dry_run prints what would run (the zero-egress-inspectable mode)."""
@@ -132,12 +148,35 @@ def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
         if dry_run:
             continue
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        if not os.path.exists(dst):
+        if os.path.exists(dst):
+            if _looks_like_html(dst):
+                # leftover from a pre-guard run that saved an interstitial
+                raise RuntimeError(
+                    f"{dst} is an HTML page, not the artifact (a saved "
+                    "download interstitial?) — delete it and re-run fetch")
+            # the manifest will record THIS file's hash — make the trust
+            # explicit so a stale/truncated leftover isn't silently blessed
+            print(f"  exists ({os.path.getsize(dst)} bytes) — trusting the "
+                  "local copy; delete it to force a re-download")
+        else:
             # download to a temp name + atomic rename: an interrupted fetch
             # never leaves a partial file at dst that a re-run would skip
             # and bless into the manifest
             tmp = dst + ".part"
             urllib.request.urlretrieve(url, tmp)  # noqa: S310 — catalog URLs only
+            if _looks_like_html(tmp):
+                # Google-Drive uc?export=download answers large files with a
+                # virus-scan interstitial page; saving it would record the
+                # HTML's hash and verify would pass on garbage
+                if "docs.google.com" in url:
+                    retry = url + "&confirm=" + _gdrive_confirm_token(tmp)
+                    print(f"  Drive interstitial detected — retrying {retry}")
+                    urllib.request.urlretrieve(retry, tmp)  # noqa: S310
+                if _looks_like_html(tmp):
+                    os.remove(tmp)
+                    raise RuntimeError(
+                        f"{url} returned an HTML page, not the artifact — "
+                        "refusing to record it in the manifest")
             os.replace(tmp, dst)
         manifest[rel] = {"sha256": _sha256(dst), "bytes": os.path.getsize(dst)}
         if unpack == "tar":

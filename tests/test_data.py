@@ -170,6 +170,63 @@ def test_acquire_fetch_end_to_end_with_file_urls(tmp_path, monkeypatch):
     manifest = json.loads(mpath.read_text())
     assert manifest["fed_emnist.tar.bz2"]["bytes"] == tarball.stat().st_size
     assert acquire.verify("femnist", str(data_dir)) == 0
-    # re-fetch skips the completed download (no .part leftovers)
+    # re-fetch skips the completed download (no .part leftovers) and says
+    # out loud that it trusted the existing copy (ADVICE r4 acquire.py:160)
     assert acquire.fetch("femnist", str(data_dir)) == 0
     assert not list(data_dir.glob("*.part"))
+
+
+def test_acquire_fetch_trusts_existing_file_loudly(tmp_path, monkeypatch, capsys):
+    from fedml_tpu.data import acquire
+
+    src = tmp_path / "remote.bin"
+    src.write_bytes(b"artifact")
+    monkeypatch.setitem(acquire.CATALOG, "mnist",
+                        [("mnist.bin", src.as_uri(), None)])
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "mnist.bin").write_bytes(b"stale local copy")
+    assert acquire.fetch("mnist", str(data_dir)) == 0
+    assert "trusting the local copy" in capsys.readouterr().out
+    # the manifest records the trusted file's hash, i.e. what is on disk
+    assert (data_dir / "mnist.bin").read_bytes() == b"stale local copy"
+
+
+def test_acquire_fetch_rejects_html_interstitial(tmp_path, monkeypatch):
+    """A Drive virus-scan page (or any HTML error page) must never be
+    recorded as the artifact (ADVICE r4 acquire.py:66): fetch retries with
+    the confirm token and, still getting HTML, refuses."""
+    from fedml_tpu.data import acquire
+
+    page = tmp_path / "interstitial"
+    page.write_bytes(b"<!DOCTYPE html><html>Download anyway? confirm=abc123</html>")
+    monkeypatch.setitem(
+        acquire.CATALOG, "shakespeare",
+        [("shakespeare/train/data.json",
+          "https://docs.google.com/uc?export=download&id=XYZ", None)])
+    calls = []
+
+    def fake_retrieve(url, dst):
+        calls.append(url)
+        import shutil
+        shutil.copy(page, dst)
+
+    monkeypatch.setattr(acquire.urllib.request, "urlretrieve", fake_retrieve)
+    data_dir = tmp_path / "data"
+    with pytest.raises(RuntimeError, match="HTML page"):
+        acquire.fetch("shakespeare", str(data_dir))
+    # the retry carried the confirm token parsed from the page
+    assert len(calls) == 2 and "confirm=abc123" in calls[1]
+    # nothing blessed: no artifact, no manifest, no .part leftovers
+    assert not (data_dir / "shakespeare" / "train" / "data.json").exists()
+    assert not list(data_dir.rglob("*.part"))
+    assert not (data_dir / f"shakespeare.{acquire.MANIFEST}").exists()
+
+    # a leftover interstitial saved by a pre-guard run is refused, not
+    # trusted into the manifest
+    import shutil
+    dst = data_dir / "shakespeare" / "train" / "data.json"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(page, dst)
+    with pytest.raises(RuntimeError, match="delete it"):
+        acquire.fetch("shakespeare", str(data_dir))

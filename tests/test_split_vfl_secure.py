@@ -436,3 +436,12 @@ def test_fedgkt_checkpoint_resume_exact(tmp_path):
                                np.asarray(resumed.server_logits), atol=1e-6)
     assert len(resumed.history) == 3
     assert len(resumed.server_loss_history) == len(straight.server_loss_history)
+
+    # direct maybe_restore on a fresh API (before train() ever ran) must
+    # also work: server_logits is still None there and the example tree's
+    # structure must match the saved one (ADVICE r4 fedgkt.py:360)
+    cold = fresh()
+    assert cold.server_logits is None
+    assert cold.maybe_restore(ck) == 3  # latest ckpt (resumed run saved r3)
+    np.testing.assert_allclose(np.asarray(cold.server_logits),
+                               np.asarray(straight.server_logits), atol=1e-6)
