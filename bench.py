@@ -77,12 +77,27 @@ def main():
     trainer = ClassificationTrainer(create_model(model_name, output_dim=out_dim, dtype=dtype))
     agg = make_aggregator("fedavg", cfg)
     n_chips = jax.device_count()
+    # silo-grouped conv lowering (docs/cross_silo_ladder.json: 1.55x @16ch):
+    # default-on for the cross-silo ResNet-56 workload, BENCH_SILO_THRESHOLD=0
+    # to disable / set a custom channel threshold on other ResNetCifar runs
+    silo_thr = int(os.environ.get(
+        "BENCH_SILO_THRESHOLD",
+        "32" if workload == "cross_silo" and n_chips == 1 else "0"))
+    silo_trainer = None
+    if silo_thr > 0 and n_chips == 1 and hasattr(trainer.module, "silo_threshold"):
+        from fedml_tpu.algorithms.silo_grouped import silo_trainer as make_silo
+
+        silo_trainer = make_silo(trainer, silo_thr)
     if n_chips > 1:
         # shard the round's clients over every chip (ICI aggregation)
         from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
 
         clients_per_round = ((clients_per_round + n_chips - 1) // n_chips) * n_chips
         round_fn = build_sharded_round_fn(trainer, cfg, agg, make_mesh())
+    elif silo_trainer is not None:
+        from fedml_tpu.algorithms.silo_grouped import build_silo_round_fn
+
+        round_fn = build_silo_round_fn(silo_trainer, cfg, agg)
     else:
         round_fn = build_round_fn(trainer, cfg, agg)
 
@@ -137,7 +152,12 @@ def main():
                       "using engine path", file=__import__("sys").stderr)
                 multi = None
         if multi is None:
-            multi = build_multi_round_fn(trainer, cfg, agg, scan_rounds)
+            if silo_trainer is not None:
+                from fedml_tpu.algorithms.silo_grouped import build_silo_multi_round_fn
+
+                multi = build_silo_multi_round_fn(silo_trainer, cfg, agg, scan_rounds)
+            else:
+                multi = build_multi_round_fn(trainer, cfg, agg, scan_rounds)
             gv, state, _ = multi(gv, state, x, y, counts, key)  # warmup/compile
             readback(gv)
         # (the fused probe above already served as its own warmup)
@@ -191,6 +211,7 @@ def main():
         "n_chips": n_chips,
         "platform": jax.devices()[0].platform,
         "fused_kernel": used_fused,
+        "silo_threshold": silo_thr if silo_trainer is not None else 0,
     }))
 
 

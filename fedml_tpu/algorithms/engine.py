@@ -134,11 +134,20 @@ def _merge_variables(variables, new_params, new_state):
     return out
 
 
-def build_local_update(trainer, cfg: FedConfig) -> Callable:
+def build_local_update(trainer, cfg: FedConfig, pvary_axes: tuple = ()) -> Callable:
     """Returns local_update(global_variables, x, y, count, rng) -> LocalResult.
 
     x: [n_max, ...], y: [n_max, ...], count: scalar int. Runs cfg.epochs of
     minibatch SGD (lax.scan over epochs and batches).
+
+    ``pvary_axes``: mesh axis names to `jax.lax.pcast(..., to='varying')` the
+    incoming global variables over — REQUIRED when this update runs inside
+    `shard_map` with replication checking on. The scan carries start as the
+    broadcast (invariant-typed) globals and become device-varying through the
+    sharded data; without the explicit pcast, jax 0.9 silently MIScompiles
+    the vmapped scan instead of raising the carry-typing error it raises for
+    the unvmapped one (~2e-2 wrong after 12 LR steps — pinned by
+    tests/test_parallel.py::test_scan_carry_pcast_jax_bug).
     """
     if cfg.epochs < 1:
         raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
@@ -154,6 +163,9 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
     stateless_opt = cfg.client_optimizer == "sgd" and not cfg.momentum and not cfg.wd
 
     def local_update(global_variables, x, y, count, rng) -> LocalResult:
+        if pvary_axes:
+            global_variables = jax.lax.pcast(
+                global_variables, pvary_axes, to="varying")
         n_max = x.shape[0]
         b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
         nb = math.ceil(n_max / b)
@@ -266,19 +278,30 @@ def build_local_update(trainer, cfg: FedConfig) -> Callable:
     return local_update
 
 
-def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
-    """Jitted synchronous round: vmap(local_update) + aggregate.
+def _vmapped_update(trainer, cfg: FedConfig) -> Callable:
+    """batched_update(gv, x[C,...], y, counts, crngs) -> LocalResult — the
+    standard client-axis execution: vmap over local_update."""
+    local_update = build_local_update(trainer, cfg)
+
+    def batched(global_variables, x, y, counts, crngs):
+        return jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+            global_variables, x, y, counts, crngs)
+
+    return batched
+
+
+def build_round_fn_from_update(batched_update, aggregator) -> Callable:
+    """Jitted synchronous round over any batched client update (the vmap
+    engine below, or the silo-grouped update in algorithms/silo_grouped.py —
+    one definition of the rng stream and metrics contract for both).
 
     Mirrors the server loop at reference FedAvgServerManager.py:43-88
     (receive all -> aggregate -> broadcast) collapsed into one XLA program.
     """
-    local_update = build_local_update(trainer, cfg)
 
     def round_fn(global_variables, agg_state, x, y, counts, rng):
         crngs = jax.random.split(rng, x.shape[0])
-        result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
-            global_variables, x, y, counts, crngs
-        )
+        result = batched_update(global_variables, x, y, counts, crngs)
         new_global, agg_state = aggregator(
             global_variables, result, counts.astype(jnp.float32), rng, agg_state
         )
@@ -289,18 +312,24 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
     return jax.jit(round_fn)
 
 
-def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -> Callable:
+def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
+    """Jitted synchronous round: vmap(local_update) + aggregate."""
+    return build_round_fn_from_update(_vmapped_update(trainer, cfg), aggregator)
+
+
+def build_multi_round_fn_from_update(batched_update, cfg: FedConfig,
+                                     aggregator, num_rounds: int) -> Callable:
     """R federated rounds as ONE jitted lax.scan — the dispatch-amortized fast
-    path. The whole federation's packed data lives on device; per round,
-    client sampling happens in-graph (jax.random.permutation prefix, the
-    in-XLA analog of the reference's np.random.seed(round_idx) choice at
-    FedAVGAggregator.py:89-97 — same distribution, different stream).
+    path, over any batched client update. The whole federation's packed data
+    lives on device; per round, client sampling happens in-graph
+    (jax.random.permutation prefix, the in-XLA analog of the reference's
+    np.random.seed(round_idx) choice at FedAVGAggregator.py:89-97 — same
+    distribution, different stream).
 
     With client_num_per_round == total clients the per-round computation is
     bit-identical to build_round_fn called sequentially with
     rng = fold_in(base_rng, round_idx) (tested in tests/test_fedavg.py).
     """
-    local_update = build_local_update(trainer, cfg)
 
     def multi_round(global_variables, agg_state, x, y, counts, base_rng):
         c_total = x.shape[0]
@@ -319,9 +348,7 @@ def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -
                 # whole federation through HBM every round — skip it
                 xs, ys, cs = x, y, counts
             crngs = jax.random.split(rng, k)
-            result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
-                gv, xs, ys, cs, crngs
-            )
+            result = batched_update(gv, xs, ys, cs, crngs)
             gv, st = aggregator(gv, result, cs.astype(jnp.float32), rng, st)
             metrics = {mk: mv.sum() for mk, mv in result.metrics.items()}
             return (gv, st), metrics
@@ -332,6 +359,12 @@ def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -
         return gv, st, metrics  # metrics leaves have leading [num_rounds]
 
     return jax.jit(multi_round)
+
+
+def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -> Callable:
+    """R vmap-engine rounds as one jitted lax.scan."""
+    return build_multi_round_fn_from_update(
+        _vmapped_update(trainer, cfg), cfg, aggregator, num_rounds)
 
 
 def build_eval_fn(trainer) -> Callable:

@@ -63,6 +63,12 @@ class FedAvgAPI(Checkpointable):
         self.trainer = model_trainer
         self.aggregator = make_aggregator(aggregator_name, config)
         self.mesh = None
+        if config.silo_threshold > 0 and config.backend == "shard_map":
+            raise ValueError(
+                "silo_threshold (the single-chip silo-grouped conv path) "
+                "and backend='shard_map' are mutually exclusive — the "
+                "grouped lowering merges silos on ONE chip; drop one of the "
+                "two settings")
         if config.backend == "shard_map":
             from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
 
@@ -73,6 +79,13 @@ class FedAvgAPI(Checkpointable):
             self.round_fn = build_sharded_round_fn(
                 model_trainer, config, self.aggregator, self.mesh
             )
+        elif config.silo_threshold > 0:
+            from fedml_tpu.algorithms.silo_grouped import (
+                build_silo_round_fn, silo_trainer)
+
+            self.round_fn = build_silo_round_fn(
+                silo_trainer(model_trainer, config.silo_threshold),
+                config, self.aggregator)
         else:
             self.round_fn = build_round_fn(model_trainer, config, self.aggregator)
         self.eval_fn = build_eval_fn(model_trainer)
@@ -200,6 +213,14 @@ class FedAvgAPI(Checkpointable):
         if chunk is None:  # same chunk geometry as the streaming path
             chunk = min(self.dataset.client_num, 64)
         uniq = {id(p): p for _, p in splits}  # test may alias train
+        if not all(isinstance(p.x, np.ndarray) for p in uniq.values()):
+            # StreamingPackedClients exposes x as a lazy decode facade with no
+            # nbytes; staging it would eagerly decode the whole split, which
+            # is exactly what streaming exists to avoid — keep the chunked path
+            log.info("resident_eval disabled: streaming (lazy-decode) split — "
+                     "using chunked eval")
+            self._resident_cache = {}
+            return None
 
         def staged_bytes(p):
             # what stage() actually device_puts: padded to a chunk multiple

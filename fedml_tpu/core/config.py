@@ -82,6 +82,12 @@ class FedConfig:
     resident_eval: bool = True
     resident_eval_budget: int = 8 << 30
     backend: str = "vmap"  # vmap (single chip) | shard_map (mesh)
+    # >0 enables the silo-grouped conv execution path (ResNetCifar models
+    # only): convs with min(cin, cout) <= silo_threshold merge the round's
+    # silos into one feature_group_count conv — measured 1.55x at 16-channel
+    # stages on the v5e (docs/cross_silo_ladder.json). Trajectories match the
+    # vmap engine to numerical tolerance (tests/test_silo_grouped.py).
+    silo_threshold: int = 0
     mesh_shape: tuple[int, ...] = ()
     dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models
 

@@ -21,6 +21,23 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def _conv(features, kernel_size, strides=(1, 1), padding="SAME",
+          silo_threshold: int = 0, dtype=None, name: str | None = None):
+    """Conv factory: plain nn.Conv, or (silo_threshold > 0) the
+    silo-grouped-lowering GroupableConv (ops/silo_conv.py). Explicit names
+    reproduce nn.Conv's auto-naming so the variables tree is structurally
+    identical either way (the silo engine path depends on this —
+    tests/test_silo_grouped.py)."""
+    if silo_threshold > 0:
+        from fedml_tpu.ops.silo_conv import GroupableConv
+
+        return GroupableConv(features=features, kernel_size=kernel_size,
+                             strides=strides, padding=padding,
+                             threshold=silo_threshold, dtype=dtype, name=name)
+    return nn.Conv(features, kernel_size, strides, padding=padding,
+                   use_bias=False, dtype=dtype, name=name)
+
+
 class _Norm(nn.Module):
     """BatchNorm (default) or GroupNorm with `channels_per_group` semantics
     (reference resnet_gn.py norm2d: GroupNorm2d(planes, num_channels_per_group))."""
@@ -40,16 +57,23 @@ class BasicBlock(nn.Module):
     stride: int = 1
     group_norm: int = 0
     expansion: int = 1
+    silo_threshold: int = 0
+    dtype: object = None  # compute dtype for convs (bf16 = MXU-native); BN
+    # keeps f32 math via flax dtype promotion (params are f32)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        st, dt = self.silo_threshold, self.dtype
         identity = x
-        out = nn.Conv(self.planes, (3, 3), (self.stride, self.stride), padding=1, use_bias=False)(x)
+        out = _conv(self.planes, (3, 3), (self.stride, self.stride), padding=1,
+                    silo_threshold=st, dtype=dt, name="Conv_0")(x)
         out = nn.relu(_Norm(self.group_norm)(out, train))
-        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(out)
+        out = _conv(self.planes, (3, 3), padding=1, silo_threshold=st, dtype=dt,
+                    name="Conv_1")(out)
         out = _Norm(self.group_norm)(out, train)
         if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
-            identity = nn.Conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride), use_bias=False)(x)
+            identity = _conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride),
+                             silo_threshold=st, dtype=dt, name="Conv_2")(x)
             identity = _Norm(self.group_norm)(identity, train)
         return nn.relu(out + identity)
 
@@ -59,18 +83,24 @@ class Bottleneck(nn.Module):
     stride: int = 1
     group_norm: int = 0
     expansion: int = 4
+    silo_threshold: int = 0
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        st, dt = self.silo_threshold, self.dtype
         identity = x
-        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = _conv(self.planes, (1, 1), silo_threshold=st, dtype=dt, name="Conv_0")(x)
         out = nn.relu(_Norm(self.group_norm)(out, train))
-        out = nn.Conv(self.planes, (3, 3), (self.stride, self.stride), padding=1, use_bias=False)(out)
+        out = _conv(self.planes, (3, 3), (self.stride, self.stride), padding=1,
+                    silo_threshold=st, dtype=dt, name="Conv_1")(out)
         out = nn.relu(_Norm(self.group_norm)(out, train))
-        out = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(out)
+        out = _conv(self.planes * self.expansion, (1, 1), silo_threshold=st, dtype=dt,
+                    name="Conv_2")(out)
         out = _Norm(self.group_norm)(out, train)
         if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
-            identity = nn.Conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride), use_bias=False)(x)
+            identity = _conv(self.planes * self.expansion, (1, 1), (self.stride, self.stride),
+                             silo_threshold=st, dtype=dt, name="Conv_3")(x)
             identity = _Norm(self.group_norm)(identity, train)
         return nn.relu(out + identity)
 
@@ -94,6 +124,16 @@ class ResNetCifar(nn.Module):
     group_norm: int = 0
     widths: Sequence[int] = (16, 32, 64)
     s2d: bool = False
+    # >0 enables the silo-grouped conv lowering under vmap (ops/silo_conv.py):
+    # convs with min(cin, cout) <= silo_threshold merge the vmapped silos into
+    # one feature_group_count conv. Use ONLY with the grad-outside-vmap silo
+    # engine (algorithms/silo_grouped.py) — vmap(grad(...)) over this model
+    # does not support reverse-mode AD through the custom batching rule.
+    silo_threshold: int = 0
+    # compute dtype for convs/fc (bfloat16 = MXU-native; the r5 profile
+    # showed the f32 default leaves the round HBM-bound — docs/PERF.md).
+    # BatchNorm math stays f32 via flax dtype promotion against f32 params.
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -101,14 +141,18 @@ class ResNetCifar(nn.Module):
             b, h, w, c = x.shape
             x = x.reshape(b, h // 2, 2, w // 2, 2, c)
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
-        x = nn.Conv(self.widths[0], (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = _conv(self.widths[0], (3, 3), padding=1,
+                  silo_threshold=self.silo_threshold, dtype=self.dtype,
+                  name="conv1")(x)
         x = nn.relu(_Norm(self.group_norm)(x, train))
         for stage, (planes, blocks) in enumerate(zip(self.widths, self.layers)):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm)(x, train)
+                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm,
+                               silo_threshold=self.silo_threshold,
+                               dtype=self.dtype)(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return nn.Dense(self.output_dim, name="fc")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(x)
 
 
 class ResNetImageNet(nn.Module):
@@ -121,56 +165,67 @@ class ResNetImageNet(nn.Module):
     layers: Sequence[int]
     output_dim: int = 1000
     group_norm: int = 0
+    dtype: object = None  # compute dtype (bf16 = MXU-native); norm math f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False, name="conv1")(x)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
         x = nn.relu(_Norm(self.group_norm)(x, train))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.layers)):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm)(x, train)
+                x = self.block(planes=planes, stride=stride, group_norm=self.group_norm,
+                               dtype=self.dtype)(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.output_dim, name="fc")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(x)
 
 
-def resnet20(output_dim=10, group_norm=0):
-    return ResNetCifar(block=BasicBlock, layers=(3, 3, 3), output_dim=output_dim, group_norm=group_norm)
+def resnet20(output_dim=10, group_norm=0, dtype=None):
+    return ResNetCifar(block=BasicBlock, layers=(3, 3, 3), output_dim=output_dim,
+                       group_norm=group_norm, dtype=dtype)
 
 
-def resnet32(output_dim=10, group_norm=0):
-    return ResNetCifar(block=BasicBlock, layers=(5, 5, 5), output_dim=output_dim, group_norm=group_norm)
+def resnet32(output_dim=10, group_norm=0, dtype=None):
+    return ResNetCifar(block=BasicBlock, layers=(5, 5, 5), output_dim=output_dim,
+                       group_norm=group_norm, dtype=dtype)
 
 
-def resnet44(output_dim=10, group_norm=0):
-    return ResNetCifar(block=BasicBlock, layers=(7, 7, 7), output_dim=output_dim, group_norm=group_norm)
+def resnet44(output_dim=10, group_norm=0, dtype=None):
+    return ResNetCifar(block=BasicBlock, layers=(7, 7, 7), output_dim=output_dim,
+                       group_norm=group_norm, dtype=dtype)
 
 
-def resnet56(output_dim=10, group_norm=0, s2d=False):
+def resnet56(output_dim=10, group_norm=0, s2d=False, dtype=None):
     return ResNetCifar(block=Bottleneck, layers=(6, 6, 6), output_dim=output_dim,
-                       group_norm=group_norm, s2d=s2d)
+                       group_norm=group_norm, s2d=s2d, dtype=dtype)
 
 
-def resnet56_s2d(output_dim=10, group_norm=0):
+def resnet56_s2d(output_dim=10, group_norm=0, dtype=None):
     """ResNet-56 with space-to-depth input — the TPU-tuned cross-silo
     variant: 3.7x the baseline's samples/s/chip at the bench config
     (docs/PERF.md cross-silo ladder). An architecture variant, not the
     reference model — accuracy must be re-validated per task."""
-    return resnet56(output_dim=output_dim, group_norm=group_norm, s2d=True)
+    return resnet56(output_dim=output_dim, group_norm=group_norm, s2d=True,
+                    dtype=dtype)
 
 
-def resnet110(output_dim=10, group_norm=0):
-    return ResNetCifar(block=Bottleneck, layers=(12, 12, 12), output_dim=output_dim, group_norm=group_norm)
+def resnet110(output_dim=10, group_norm=0, dtype=None):
+    return ResNetCifar(block=Bottleneck, layers=(12, 12, 12), output_dim=output_dim,
+                       group_norm=group_norm, dtype=dtype)
 
 
-def resnet18(output_dim=1000, group_norm=0):
-    return ResNetImageNet(block=BasicBlock, layers=(2, 2, 2, 2), output_dim=output_dim, group_norm=group_norm)
+def resnet18(output_dim=1000, group_norm=0, dtype=None):
+    return ResNetImageNet(block=BasicBlock, layers=(2, 2, 2, 2), output_dim=output_dim,
+                          group_norm=group_norm, dtype=dtype)
 
 
-def resnet34(output_dim=1000, group_norm=0):
-    return ResNetImageNet(block=BasicBlock, layers=(3, 4, 6, 3), output_dim=output_dim, group_norm=group_norm)
+def resnet34(output_dim=1000, group_norm=0, dtype=None):
+    return ResNetImageNet(block=BasicBlock, layers=(3, 4, 6, 3), output_dim=output_dim,
+                          group_norm=group_norm, dtype=dtype)
 
 
-def resnet50(output_dim=1000, group_norm=0):
-    return ResNetImageNet(block=Bottleneck, layers=(3, 4, 6, 3), output_dim=output_dim, group_norm=group_norm)
+def resnet50(output_dim=1000, group_norm=0, dtype=None):
+    return ResNetImageNet(block=Bottleneck, layers=(3, 4, 6, 3), output_dim=output_dim,
+                          group_norm=group_norm, dtype=dtype)

@@ -3,12 +3,20 @@
 from __future__ import annotations
 
 from fedml_tpu.models.registry import register_model
-from fedml_tpu.models.linear import LogisticRegression, DenseMLP
+from fedml_tpu.models.linear import LogisticRegression, DenseMLP, ReferenceMLP
 from fedml_tpu.models.cnn import CNN_OriginalFedAvg, CNN_DropOut, CNNCifar, HAR_CNN
 from fedml_tpu.models import resnet as _resnet
 from fedml_tpu.models.mobilenet import MobileNet
 from fedml_tpu.models.rnn import RNN_OriginalFedAvg, RNN_StackOverFlow
 from fedml_tpu.models.vgg import VGG
+
+
+def _compute_dtype(kw):
+    """'bfloat16' -> jnp.bfloat16 (MXU-native), else None (flax promotes to
+    f32 against f32 params) — one mapping for every dtype-aware factory."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if kw.get("dtype") == "bfloat16" else None
 
 
 @register_model("lr")
@@ -21,6 +29,18 @@ def _mlp(output_dim, **kw):
     return DenseMLP(output_dim=output_dim, hidden=tuple(kw.get("hidden", (1024, 512, 256, 128))))
 
 
+@register_model("purchasemlp")
+def _purchasemlp(output_dim, **kw):
+    # reference dense_mlp.py:11 PurchaseMLP(input_dim=600, n_classes=100)
+    return ReferenceMLP(output_dim=output_dim, hidden=(256,))
+
+
+@register_model("texasmlp")
+def _texasmlp(output_dim, **kw):
+    # reference dense_mlp.py:53 TexasMLP(input_dim=6169, n_classes=100)
+    return ReferenceMLP(output_dim=output_dim, hidden=(1024, 512))
+
+
 @register_model("cnn_fedavg")
 def _cnn_fedavg(output_dim, **kw):
     return CNN_OriginalFedAvg(output_dim=output_dim)
@@ -31,8 +51,8 @@ def _cnn(output_dim, **kw):
     # reference "cnn" for femnist = CNN_DropOut (main_fedavg.py:233-236)
     import jax.numpy as jnp
 
-    dtype = jnp.bfloat16 if kw.get("dtype") == "bfloat16" else jnp.float32
-    return CNN_DropOut(output_dim=output_dim, dtype=dtype)
+    return CNN_DropOut(output_dim=output_dim,
+                       dtype=_compute_dtype(kw) or jnp.float32)
 
 
 @register_model("cnn_cifar")
@@ -49,7 +69,8 @@ def _har_cnn(output_dim, **kw):
 for _name in ("resnet20", "resnet32", "resnet44", "resnet56", "resnet56_s2d",
               "resnet110", "resnet18", "resnet34", "resnet50"):
     def _make(output_dim, _f=getattr(_resnet, _name), **kw):
-        return _f(output_dim=output_dim, group_norm=kw.get("group_norm", 0))
+        return _f(output_dim=output_dim, group_norm=kw.get("group_norm", 0),
+                  dtype=_compute_dtype(kw))
 
     register_model(_name)(_make)
 
@@ -57,7 +78,8 @@ for _name in ("resnet20", "resnet32", "resnet44", "resnet56", "resnet56_s2d",
 @register_model("resnet18_gn")
 def _resnet18_gn(output_dim, **kw):
     # fed_cifar100 model: GroupNorm replaces BN for FL (BASELINE.md 44.7 target)
-    return _resnet.resnet18(output_dim=output_dim, group_norm=kw.get("group_norm", 2))
+    return _resnet.resnet18(output_dim=output_dim, group_norm=kw.get("group_norm", 2),
+                            dtype=_compute_dtype(kw))
 
 
 @register_model("mobilenet")

@@ -1,0 +1,118 @@
+"""Silo-grouped convolution lowering — the cross-silo MXU-filling transform.
+
+CIFAR ResNets run 16-64 channel stages: a single silo's conv fills at most
+half the MXU's 128 lanes, and `vmap`-over-silos lowers each conv to a
+batched conv that keeps the lanes idle. The r4 measurement
+(`docs/cross_silo_ladder.json`, tools/bench_cross_silo.py) showed that
+merging S silos' convs into ONE `feature_group_count=S` conv — channel
+blocks side by side, so S silos' narrow channels fill the lanes together —
+beats the vmap lowering 1.55x at 16-channel and 1.22x at 32-channel stages,
+but LOSES (0.62x) at 64 channels where a single silo already fills the MXU.
+
+`GroupableConv` is an `nn.Conv(use_bias=False)` drop-in whose lowering
+under `jax.vmap` makes exactly that choice per conv: grouped when
+min(cin, cout) <= ``threshold``, the default vmap lowering otherwise. The
+mechanism is `jax.custom_batching.custom_vmap`, so the UNBATCHED behavior
+(single model, eval paths) is bit-identical to `nn.Conv` — the parameter
+name ('kernel'), shape, dtype promotion, and initializer match `nn.Conv`,
+making variables trees interchangeable with the plain model's.
+
+Autodiff caveat that shapes the engine integration: `custom_vmap` composes
+as grad(vmap(f)) but NOT vmap(grad(f)) (reverse-mode under the batching
+rule is unsupported in JAX). The silo-grouped local update
+(`fedml_tpu.algorithms.silo_grouped`) therefore puts the client axis INSIDE
+the loss (one vmapped forward, per-silo losses summed) and differentiates
+outside — mathematically identical per silo because silos share no
+parameters.
+
+Reference scope anchor: the cross-silo ResNet-56 benchmark config
+(reference benchmark/README.md:103-112); there is no reference counterpart
+for the transform itself — it is a TPU-first execution-path optimization.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
+
+
+def _normalize_padding(padding, kernel_size: Sequence[int]):
+    """flax-style padding (int | str | seq) -> lax-style for a 2D conv."""
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return [(padding, padding)] * len(kernel_size)
+    return [((p, p) if isinstance(p, int) else tuple(p)) for p in padding]
+
+
+def make_silo_conv(strides, padding, threshold: int):
+    """Build the custom_vmap'd conv(x, w) for one call-site config.
+
+    Unbatched: plain `lax.conv_general_dilated` (== nn.Conv, bias-free).
+    Under vmap with x and w both batched: one feature_group_count=S conv
+    when min(cin, cout) <= threshold, else the default vmap lowering.
+    """
+
+    def base(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, strides, padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    silo_conv = custom_vmap(base)
+
+    @silo_conv.def_vmap
+    def _rule(axis_size, in_batched, x, w):  # noqa: ANN001 — jax hook
+        x_b, w_b = in_batched
+        if x_b and w_b:
+            s = axis_size
+            cin, cout = w.shape[-2], w.shape[-1]
+            if min(cin, cout) <= threshold:
+                b, h, wd = x.shape[1], x.shape[2], x.shape[3]
+                kh, kw = w.shape[1], w.shape[2]
+                # channel blocks side by side: group g == silo g
+                xg = jnp.transpose(x, (1, 2, 3, 0, 4)).reshape(b, h, wd, s * cin)
+                wg = jnp.transpose(w, (1, 2, 3, 0, 4)).reshape(kh, kw, cin, s * cout)
+                out = jax.lax.conv_general_dilated(
+                    xg, wg, strides, padding, feature_group_count=s,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                out = out.reshape(out.shape[:3] + (s, cout))
+                return jnp.transpose(out, (3, 0, 1, 2, 4)), True
+        out = jax.vmap(base, in_axes=(0 if x_b else None, 0 if w_b else None))(x, w)
+        return out, True
+
+    return silo_conv
+
+
+class GroupableConv(nn.Module):
+    """Bias-free nn.Conv drop-in with silo-grouped vmap lowering.
+
+    Parameter layout ('kernel', [kh, kw, cin, features], lecun_normal) and
+    dtype promotion match nn.Conv exactly, so a variables tree produced
+    with GroupableConv(name="Conv_0") is structurally identical to the
+    plain model's nn.Conv auto-named tree.
+    """
+
+    features: int
+    kernel_size: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: int | str | Sequence = "SAME"
+    threshold: int = 32
+    dtype: jnp.dtype | None = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            tuple(self.kernel_size) + (cin, self.features), self.param_dtype)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        conv = make_silo_conv(
+            tuple(self.strides),
+            _normalize_padding(self.padding, self.kernel_size),
+            self.threshold)
+        return conv(x, kernel)

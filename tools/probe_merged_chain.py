@@ -1,0 +1,82 @@
+"""Probe: grouped-conv chain in PURE merged layout (transposes only at the
+ends) vs vmap lowering — forward AND fwd+bwd — at the ResNet-56 stage shapes.
+
+Decides whether a hand-written merged-layout forward (stage-boundary
+transposes only) can reach the cross-silo >=9k target, before building it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.utils.cache import enable_compile_cache
+
+enable_compile_cache()
+
+S, BS = 10, 64
+DEPTH = 6
+
+
+def _time(fn, args, inner=16, reps=3):
+    out = fn(*args)
+    float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def probe(hw, c):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(S, BS, hw, hw, c), jnp.bfloat16)
+    ws = [jnp.asarray(rng.rand(S, 3, 3, c, c), jnp.bfloat16) for _ in range(DEPTH)]
+
+    def vmap_chain(x, ws):
+        def one(x, *ws):
+            for w in ws:
+                x = jax.nn.relu(jax.lax.conv_general_dilated(
+                    x, w, (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC")))
+            return x
+        return jax.vmap(one)(x, *ws)
+
+    def merged_chain(x, ws):
+        # ONE merge in, one unmerge out; the whole chain stays [B,H,W,S*C]
+        xg = jnp.transpose(x, (1, 2, 3, 0, 4)).reshape(BS, hw, hw, S * c)
+        for w in ws:
+            wg = jnp.transpose(w, (1, 2, 3, 0, 4)).reshape(3, 3, c, S * c)
+            xg = jax.nn.relu(jax.lax.conv_general_dilated(
+                xg, wg, (1, 1), "SAME", feature_group_count=S,
+                dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        out = xg.reshape(BS, hw, hw, S, c)
+        return jnp.transpose(out, (3, 0, 1, 2, 4))
+
+    recs = {}
+    for name, fn in [("vmap", vmap_chain), ("merged", merged_chain)]:
+        fwd = jax.jit(fn)
+        recs[f"{name}_fwd_ms"] = round(_time(fwd, (x, ws)) * 1e3, 3)
+
+        def loss(x, ws, fn=fn):
+            return fn(x, ws).astype(jnp.float32).sum()
+
+        bwd = jax.jit(jax.grad(loss, argnums=1))
+        recs[f"{name}_fwdbwd_ms"] = round(_time(bwd, (x, ws)) * 1e3, 3)
+    recs["fwd_speedup"] = round(recs["vmap_fwd_ms"] / recs["merged_fwd_ms"], 2)
+    recs["fwdbwd_speedup"] = round(
+        recs["vmap_fwdbwd_ms"] / recs["merged_fwdbwd_ms"], 2)
+    print(json.dumps({"shape": f"{hw}x{hw}x{c}", **recs}))
+
+
+if __name__ == "__main__":
+    print(f"# devices: {jax.devices()}")
+    for hw, c in [(32, 16), (16, 32), (8, 64)]:
+        probe(hw, c)

@@ -1,0 +1,77 @@
+"""Profile the cross-silo round: vmap engine vs silo-grouped path.
+
+Where does the 0.35 s round actually go? The r4 microbenches said grouped
+convs win 1.55x at narrow stages, but the shipped silo path nets only +4% —
+this tool captures a device trace of both paths and prints the per-op
+budget so the gap has a measured explanation (transposes? conv kernels?
+BN/elementwise? dispatch?).
+
+Usage: python tools/profile_cross_silo.py [vmap|silo] [outdir]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def run(path: str, trace_dir: str, rounds_in_trace: int = 2):
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+    from fedml_tpu.algorithms.silo_grouped import build_silo_round_fn, silo_trainer
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.resnet import Bottleneck, ResNetCifar
+    from fedml_tpu.utils.cache import enable_compile_cache
+    from fedml_tpu.utils.logging import profile_trace
+
+    enable_compile_cache()
+    cfg = FedConfig(batch_size=64, epochs=1, lr=0.1, client_optimizer="sgd",
+                    dtype="bfloat16", assume_full_clients=True,
+                    client_num_per_round=10)
+    trainer = ClassificationTrainer(
+        ResNetCifar(block=Bottleneck, layers=(6, 6, 6), output_dim=10))
+    agg = make_aggregator("fedavg", cfg)
+    if path == "silo":
+        fn = build_silo_round_fn(silo_trainer(trainer, 32), cfg, agg)
+    else:
+        fn = build_round_fn(trainer, cfg, agg)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(10, 256, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, size=(10, 256)).astype(np.int32))
+    counts = jnp.full((10,), 256, jnp.int32)
+    gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
+    st = agg.init_state(gv)
+    key = jax.random.PRNGKey(1)
+
+    gv2, st2, _ = fn(gv, st, x, y, counts, key)  # compile
+    float(np.asarray(jax.tree.leaves(gv2)[0]).ravel()[0])
+
+    t0 = time.perf_counter()
+    with profile_trace(trace_dir):
+        for r in range(rounds_in_trace):
+            gv2, st2, _ = fn(gv, st, x, y, counts, jax.random.fold_in(key, r))
+        float(np.asarray(jax.tree.leaves(gv2)[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"[{path}] traced {rounds_in_trace} rounds in {dt*1e3:.1f} ms wall")
+    return rounds_in_trace
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "vmap"
+    trace_dir = sys.argv[2] if len(sys.argv) > 2 else f"docs/traces/cross_silo_{path}"
+    os.makedirs(trace_dir, exist_ok=True)
+    n = run(path, trace_dir)
+    sys.path.insert(0, os.path.dirname(__file__))
+    from profile_flagship import summarize_xplane
+
+    summarize_xplane(trace_dir, n, top_k=30)
+
+
+if __name__ == "__main__":
+    main()
