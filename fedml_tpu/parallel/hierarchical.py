@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fedml_tpu.algorithms.aggregators import tree_weighted_mean_psum
 from fedml_tpu.algorithms.engine import build_local_update
 from fedml_tpu.core.config import FedConfig
 
@@ -47,7 +48,9 @@ def build_sharded_hierarchical_round_fn(
     mesh.shape[client_axis] (pad with zero-count clients / empty groups —
     weight-0 no-ops at both averaging levels).
     """
-    local_update = build_local_update(trainer, cfg)
+    # clients-axis pcast: each client's scan carries become varying over the
+    # clients axis; the groups axis is handled at the inner-round scan below
+    local_update = build_local_update(trainer, cfg, pvary_axes=(client_axis,))
     g_dev = mesh.shape[group_axis]
     c_dev = mesh.shape[client_axis]
 
@@ -61,6 +64,11 @@ def build_sharded_hierarchical_round_fn(
         grngs = jax.lax.dynamic_slice_in_dim(all_grngs, gidx * g_loc, g_loc)
 
         def group_train(gv, xg, yg, cg, grng):
+            # inner-scan carry: starts as the invariant global broadcast,
+            # exits varying over the groups axis (each group trains its own
+            # line) — pcast so the carry types match under check_vma
+            gv = jax.lax.pcast(gv, (group_axis,), to="varying")
+
             def inner_round(gv, r_rng):
                 # same client-key table: split(r_rng, C)[c]
                 all_crngs = jax.random.split(r_rng, c_total)
@@ -69,16 +77,10 @@ def build_sharded_hierarchical_round_fn(
                     gv, xg, yg, cg, crngs
                 )
                 # group-local weighted mean == psum over the clients axis
-                # (ICI); denominator guarded so an empty padded group
-                # produces zeros (weight-0 at the cloud level), not NaN
-                w = cg.astype(jnp.float32)
-                wn = w / jnp.maximum(jax.lax.psum(w.sum(), client_axis), 1e-12)
-
-                def avg(leaf):
-                    wb = wn.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
-                    return jax.lax.psum(jnp.sum(leaf * wb, axis=0), client_axis)
-
-                new_gv = jax.tree.map(avg, result.variables)
+                # (ICI); the shared helper's guarded denominator makes an
+                # empty padded group zeros (weight-0 at the cloud), not NaN
+                new_gv = tree_weighted_mean_psum(
+                    result.variables, cg.astype(jnp.float32), client_axis)
                 metrics = {
                     k: jax.lax.psum(v.sum(), client_axis)
                     for k, v in result.metrics.items()
@@ -96,31 +98,19 @@ def build_sharded_hierarchical_round_fn(
         # cloud level: weighted mean over groups — the once-per-global-round
         # cross-slice reduction
         gw = jax.lax.psum(counts.sum(axis=1).astype(jnp.float32), client_axis)
-        gwn = gw / jnp.maximum(jax.lax.psum(gw.sum(), group_axis), 1e-12)
-
-        def cloud_avg(leaf):
-            wb = gwn.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
-            return jax.lax.psum(jnp.sum(leaf * wb, axis=0), group_axis)
-
-        new_global = jax.tree.map(cloud_avg, group_vars)
+        new_global = tree_weighted_mean_psum(group_vars, gw, group_axis)
         out_metrics = {
             k: jax.lax.psum(v.sum(), group_axis) for k, v in metrics.items()
         }
         return new_global, out_metrics
 
     def round_fn(global_variables, x, y, counts, rng):
-        # check_vma=False for the same narrow reason as sharded.py: the
-        # replicated outputs flow through in-group all_gathers whose
-        # invariance the Auto-mesh VMA system cannot express; replication is
-        # instead asserted bit-exactly against the vmap hierarchical round
-        # (tests/test_parallel.py + __graft_entry__.dryrun_multichip).
         sharded = jax.shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), P(group_axis, client_axis), P(group_axis, client_axis),
                       P(group_axis, client_axis), P()),
             out_specs=(P(), P()),
-            check_vma=False,
         )
         return sharded(global_variables, x, y, counts, rng)
 

@@ -29,18 +29,30 @@ log = logging.getLogger(__name__)
 
 def init_multihost(coordinator_address: str | None = None,
                    num_processes: int | None = None,
-                   process_id: int | None = None) -> dict[str, int]:
+                   process_id: int | None = None,
+                   initialization_timeout: int | None = None) -> dict[str, int]:
     """Initialize the JAX distributed runtime (idempotent; no-op when
-    unconfigured single-process). Returns topology info."""
+    unconfigured single-process). Returns topology info.
+
+    ``initialization_timeout`` (seconds) bounds how long a process waits for
+    missing peers at startup — a dead silo then surfaces as a clean
+    RuntimeError instead of an indefinite hang (the reference's mpirun
+    deployment just hangs; tests/test_multihost.py asserts the error)."""
     if coordinator_address is not None:
-        try:
+        if jax.distributed.is_initialized():
+            log.info("jax.distributed already initialized — skipping")
+        else:
+            kwargs = {}
+            if initialization_timeout is not None:
+                kwargs["initialization_timeout"] = initialization_timeout
+            # no exception catching: a peer-wait timeout must propagate as
+            # the failure it is (tests/test_multihost.py defector case)
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
+                **kwargs,
             )
-        except RuntimeError as e:  # already initialized
-            log.info("jax.distributed already initialized: %s", e)
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

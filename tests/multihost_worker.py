@@ -1,12 +1,16 @@
-"""Worker for the 2-process jax.distributed CPU test (run by
-tests/test_multihost.py). Each process owns 4 virtual CPU devices; the two
-form one 8-device global mesh — the cross-silo deployment shape of
+"""Worker for the N-process jax.distributed CPU tests (run by
+tests/test_multihost.py). The N processes each own 8//N virtual CPU devices
+and form one 8-device global mesh — the cross-silo deployment shape of
 fedml_tpu.parallel.multihost (the mpirun replacement, SURVEY §2.9).
 
 Exercises the control plane (broadcast_from_server, allgather_metrics,
-assert_same_across_processes, round_barrier) and one sharded FedAvg round
-whose clients span both processes, asserting the result is identical on
-every process.
+assert_same_across_processes, round_barrier), one sharded FedAvg round whose
+clients span every process, the two-level (groups, clients) hierarchical
+mesh, and the node-per-device ppermute gossip ACROSS processes.
+
+Modes (argv[4]): "train" (default) — the full exercise; "defect" — this
+process exits immediately WITHOUT joining, so its peers must fail with a
+clean startup-timeout error instead of hanging (failure-detection test).
 """
 
 import os
@@ -17,9 +21,14 @@ def main():
     pid = int(sys.argv[1])
     nproc = int(sys.argv[2])
     port = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "train"
+    if mode == "defect" and pid == nproc - 1:
+        print(f"DEFECTOR pid={pid} exiting without joining")
+        return
     os.environ["JAX_PLATFORMS"] = "cpu"
+    n_local = 8 // nproc
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=4")
+                               + f" --xla_force_host_platform_device_count={n_local}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -35,10 +44,11 @@ def main():
         round_barrier,
     )
 
-    info = init_multihost(f"localhost:{port}", nproc, pid)
-    assert info["process_count"] == 2, info
+    info = init_multihost(f"localhost:{port}", nproc, pid,
+                          initialization_timeout=30 if mode == "defect" else None)
+    assert info["process_count"] == nproc, info
     assert info["global_device_count"] == 8, info
-    assert info["local_device_count"] == 4, info
+    assert info["local_device_count"] == n_local, info
 
     # ---- control plane (DCN collectives replacing MPI messages)
     local = np.arange(4, dtype=np.int32) + (100 if pid == 0 else -7)
@@ -46,7 +56,8 @@ def main():
     assert (got == np.arange(4) + 100).all(), got  # process-0 value wins
 
     m = allgather_metrics({"correct": 1.0 + pid, "total": 10.0})
-    assert m["correct"] == 3.0 and m["total"] == 20.0, m
+    assert m["correct"] == sum(1.0 + p for p in range(nproc)), m
+    assert m["total"] == 10.0 * nproc, m
 
     assert_same_across_processes(np.asarray([42, 43]), "sanity")
     round_barrier("test", 0)
@@ -110,10 +121,19 @@ def main():
     # reference trajectory computed locally on full (seed-identical) data
     hv_ref, _ = hier_vmap(variables, jnp.asarray(hx), jnp.asarray(hy),
                           jnp.asarray(hc), hrng)
+    # this process's block of the (groups, clients) grid: devices are laid
+    # out row-major, so proc p owns group (p*n_local)//CG, columns
+    # (p*n_local)%CG onward — 1 whole group at nproc=2, half a group at
+    # nproc=4 (the in-group psum then spans TWO processes)
+    g0, c0 = (pid * n_local) // CG, (pid * n_local) % CG
+    cw = min(n_local, CG)
     hsh = NamedSharding(hmesh, P("groups", "clients"))
-    ghx = jax.make_array_from_process_local_data(hsh, hx[pid:pid + 1], hx.shape)
-    ghy = jax.make_array_from_process_local_data(hsh, hy[pid:pid + 1], hy.shape)
-    ghc = jax.make_array_from_process_local_data(hsh, hc[pid:pid + 1], hc.shape)
+    ghx = jax.make_array_from_process_local_data(
+        hsh, hx[g0:g0 + 1, c0:c0 + cw], hx.shape)
+    ghy = jax.make_array_from_process_local_data(
+        hsh, hy[g0:g0 + 1, c0:c0 + cw], hy.shape)
+    ghc = jax.make_array_from_process_local_data(
+        hsh, hc[g0:g0 + 1, c0:c0 + cw], hc.shape)
     hv2, _ = hier_shard(variables, ghx, ghy, ghc, hrng)
     jax.block_until_ready(hv2)
     hleaf_ref = np.asarray(hv_ref["params"]["linear"]["kernel"])
@@ -122,6 +142,28 @@ def main():
         "cross-process two-level mesh drifted from the vmapped round: "
         f"{np.abs(hleaf - hleaf_ref).max()}")
     assert_same_across_processes(hleaf.astype(np.float32), "hier_kernel")
+
+    # ---- node-per-device ppermute gossip ACROSS processes: the sharded
+    # ring exchange must equal the dense W @ x mix computed locally
+    from fedml_tpu.core.topology import SymmetricTopologyManager
+    from fedml_tpu.parallel.gossip import build_sharded_mix
+
+    topo = SymmetricTopologyManager(C, 4)
+    topo.generate_topology()
+    W = np.asarray(topo.topology, np.float32)
+    gmesh = Mesh(np.array(jax.devices()).reshape(C), ("clients",))
+    node_x = rng.rand(C, 6).astype(np.float32)
+    gsh = NamedSharding(gmesh, P("clients"))
+    gx_nodes = jax.make_array_from_process_local_data(
+        gsh, node_x[lo:hi], node_x.shape)
+    from jax.experimental import multihost_utils
+
+    mixed = build_sharded_mix(W, gmesh, axis_name="clients")({"w": gx_nodes})
+    got_mix = np.asarray(multihost_utils.process_allgather(mixed["w"],
+                                                           tiled=True))
+    want_mix = W @ node_x
+    assert np.abs(got_mix - want_mix).max() < 1e-5, (
+        f"cross-process gossip drifted: {np.abs(got_mix - want_mix).max()}")
 
     round_barrier("test", 1)
     print(f"MULTIHOST_OK pid={pid}")

@@ -1,7 +1,8 @@
-"""2-process jax.distributed CPU test (VERDICT r1 item 9): proves the
-multihost control plane and a cross-process sharded round without TPUs.
-Spawns two subprocesses with a local coordinator; each owns 4 virtual CPU
-devices of one 8-device global mesh."""
+"""Multi-process jax.distributed CPU tests (VERDICT r1 item 9; widened per
+VERDICT r4 weak #5): the multihost control plane, cross-process sharded +
+hierarchical rounds, cross-process ppermute gossip, and killed-process
+failure detection — all without TPUs. Workers share one 8-device global
+mesh (8 // nproc virtual CPU devices each)."""
 
 import os
 import socket
@@ -17,30 +18,60 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_round():
+def _spawn_workers(nproc: int, mode: str = "train"):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = dict(os.environ)
     env.pop("PYTHONSTARTUP", None)
     # the worker sets its own JAX_PLATFORMS/XLA_FLAGS before importing jax;
-    # strip any inherited device-count forcing so 4-per-process sticks
+    # strip any inherited device-count forcing so 8/nproc-per-process sticks
     env["XLA_FLAGS"] = ""
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
-    procs = [
-        subprocess.Popen([sys.executable, worker, str(pid), "2", str(port)],
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True, env=env)
-        for pid in range(2)
+    return [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), str(nproc), str(port), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(nproc)
     ]
+
+
+def _communicate(procs, timeout):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         pytest.fail("multihost workers timed out:\n" + "\n".join(outs))
+    return outs
+
+
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_distributed_round_n_processes(nproc):
+    """Control plane + sharded FedAvg + two-level hierarchical mesh +
+    ppermute gossip across nproc real processes. At nproc=4 each hierarchy
+    group's in-group psum itself spans two processes (the 2x2 grid the
+    verdict asked for)."""
+    procs = _spawn_workers(nproc)
+    outs = _communicate(procs, timeout=420)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"MULTIHOST_OK pid={pid}" in out, out
+
+
+def test_dead_process_fails_cleanly():
+    """Failure detection: when a silo never joins, the surviving processes
+    must terminate with a clear startup-timeout error — bounded by
+    init_multihost(initialization_timeout=30) — not hang (the reference's
+    mpirun deployment hangs until the scheduler kills it)."""
+    procs = _spawn_workers(2, mode="defect")
+    outs = _communicate(procs, timeout=180)
+    # worker 1 defects by design
+    assert procs[1].returncode == 0 and "DEFECTOR" in outs[1]
+    # worker 0 must FAIL (not hang, not succeed), with a timeout diagnostic
+    assert procs[0].returncode != 0, outs[0]
+    assert "MULTIHOST_OK" not in outs[0]
+    assert ("timed out" in outs[0].lower() or "timeout" in outs[0].lower()
+            or "deadline" in outs[0].lower()), outs[0]
