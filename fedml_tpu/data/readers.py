@@ -277,6 +277,32 @@ def read_har(data_dir: str):
     return None
 
 
+def read_har_subjects(data_dir: str):
+    """read_har plus the per-window subject ids (subject_{train,test}.txt,
+    1-indexed volunteer ids -> 0-based; reference HAR/subject_dataloader.py
+    load_har_data) — the grouping variable for the har_subject partition.
+    Returns (xtr, ytr, str_, xte, yte, ste) or None."""
+    base = read_har(data_dir)
+    if base is None:
+        return None
+    xtr, ytr, xte, yte = base
+    subj = []
+    for root in (data_dir, os.path.join(data_dir, "UCI HAR Dataset"),
+                 os.path.join(data_dir, "har")):
+        if os.path.isdir(os.path.join(root, "train", "Inertial Signals")):
+            for group in ("train", "test"):
+                s = np.loadtxt(os.path.join(root, group, f"subject_{group}.txt"),
+                               dtype=np.int64).reshape(-1)
+                # contiguous 0-based group labels (train/test hold disjoint
+                # volunteer id sets; p-hetero groups by unique label)
+                _, s = np.unique(s, return_inverse=True)
+                subj.append(s.astype(np.int32))
+            break
+    if len(subj) != 2:
+        return None
+    return xtr, ytr, subj[0], xte, yte, subj[1]
+
+
 # ---------------------------------------------------------------------------
 # UCIAdult / purchase100 / texas100
 

@@ -79,6 +79,11 @@ def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
     random.seed(args.seed)
     np.random.seed(args.seed)
     cfg = config_from_args(args)
+    extra_load = {}
+    if args.dataset == "mnist":
+        # reference mnist feeds lr a flat 784 vector and CNN_DropOut 28x28
+        # images (standalone main_fedavg.py:318-325) — flatten by model
+        extra_load["flatten"] = args.model in ("lr", "mlp")
     ds = load_dataset(
         args.dataset,
         data_dir=args.data_dir,
@@ -86,12 +91,23 @@ def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
         partition_method=args.partition_method,
         partition_alpha=args.partition_alpha,
         seed=args.seed,
+        **extra_load,
     )
     model_kwargs = {}
     if args.dataset in ("shakespeare", "fed_shakespeare"):
         model_kwargs["vocab_size"] = 90
         model_kwargs["per_position"] = args.dataset == "fed_shakespeare"
-    module = create_model(args.model, output_dim=ds.class_num, **model_kwargs)
+    # dataset-contextual "cnn" dispatch, exactly the reference's
+    # (standalone main_fedavg.py:315-340: cnn+har -> HAR_CNN,
+    # cnn+cifar10 -> CNNCifar, cnn+mnist-family/femnist -> CNN_DropOut) —
+    # the examples/baseline scripts rely on it
+    model_name = args.model
+    if model_name == "cnn":
+        if args.dataset in ("har", "har_subject"):
+            model_name = "har_cnn"
+        elif args.dataset == "cifar10":
+            model_name = "cnn_cifar"
+    module = create_model(model_name, output_dim=ds.class_num, **model_kwargs)
     # task trainer by dataset (reference FedAvgAPI.py:33-39)
     if ds.meta.get("task") == "nwp" or args.dataset in ("fed_shakespeare", "stackoverflow_nwp"):
         trainer = NWPTrainer(module, pad_id=0)

@@ -75,7 +75,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=str, required=True)
     parser.add_argument("--override", type=str, nargs="*", default=[],
-                        help="key=value overrides applied on top of the YAML")
+                        action="extend",
+                        help="key=value overrides applied on top of the YAML; "
+                             "repeatable (occurrences accumulate)")
     args = parser.parse_args(argv)
     cfg = _load_yaml(args.config)
     algo = cfg.get("algorithm", "fedavg")

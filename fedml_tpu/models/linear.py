@@ -28,8 +28,9 @@ class LogisticRegression(nn.Module):
 
 
 class DenseMLP(nn.Module):
-    """Tanh MLP (reference dense_mlp.py PurchaseMLP hidden=(1024,512,256,128),
-    TexasMLP hidden=(2048,1024,512,256,128))."""
+    """Generic tanh MLP (the fork's ensemble/membership-inference experiments
+    use stacks like this; see ReferenceMLP below for the exact baseline
+    architectures)."""
 
     output_dim: int
     hidden: Sequence[int] = (1024, 512, 256, 128)
@@ -40,4 +41,29 @@ class DenseMLP(nn.Module):
             x = x.reshape((x.shape[0], -1))
         for i, h in enumerate(self.hidden):
             x = nn.tanh(nn.Dense(h, name=f"fc{i + 1}")(x))
+        return nn.Dense(self.output_dim, name="out")(x)
+
+
+class ReferenceMLP(nn.Module):
+    """The baseline MLPs exactly as the living reference defines them
+    (linear/dense_mlp.py): relu(fc) -> dropout(0.5) per hidden layer, then a
+    linear head.
+
+      PurchaseMLP (dense_mlp.py:11-51):  hidden (256,),       input 600
+      TexasMLP    (dense_mlp.py:53-100): hidden (1024, 512),  input 6169
+
+    Registered as model names `purchasemlp` / `texasmlp` so the reference's
+    examples/baseline/{purchase,texas}_*.sh configs transfer verbatim."""
+
+    output_dim: int
+    hidden: Sequence[int] = (256,)
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim > 2:
+            x = x.reshape((x.shape[0], -1))
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc{i + 1}")(x))
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return nn.Dense(self.output_dim, name="out")(x)
