@@ -1,6 +1,6 @@
-"""Benchmark: FedAvg rounds/sec + samples/sec/chip.
+"""Benchmark: FedAvg rounds/sec + samples/sec/chip (+ zoo rungs).
 
-Two workloads (BENCH_WORKLOAD env):
+Workloads (BENCH_WORKLOAD env):
   flagship (default) — mirrors the reference's FEMNIST north star
     (BASELINE.md: 3400 clients, 10 clients/round, CNN_DropOut, bs 20, E=1,
     SGD lr 0.1 — reference benchmark/README.md:56-59) with FEMNIST-shaped
@@ -8,17 +8,25 @@ Two workloads (BENCH_WORKLOAD env):
   cross_silo — the BASELINE.md cross-silo table: CIFAR-10-shaped data,
     ResNet-56, 10 silos, bs 64 (reference benchmark/README.md:103-112),
     where arithmetic intensity is high enough for MFU to be meaningful.
+  fednas | fedgkt | fedseg | turboaggregate — one measured round (or, for
+    turboaggregate, the secure-vs-plain aggregation overhead at flagship
+    model size) per non-FedAvg family (VERDICT r4 next #4: "measured, not
+    argued" for the rest of the zoo).
+
+Timing is variance-aware (VERDICT r4 next #5): BENCH_REPS (default 5)
+repeats, value = MEDIAN, and the JSON carries a `spread` {min, max, reps}
+field — the regression threshold this implies is recorded in docs/PERF.md.
 
 The reference publishes no throughput numbers (BASELINE.json "published": {}),
 so vs_baseline is null unless a reference measurement is provided via
-BENCH_REF_SAMPLES_PER_SEC_PER_CHIP. See docs/PERF.md for the profile and
-roofline analysis behind these configs.
+BENCH_REF_SAMPLES_PER_SEC_PER_CHIP.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 import json
 import os
+import statistics
 import time
 
 import numpy as np
@@ -39,6 +47,188 @@ WORKLOADS = {
 }
 
 
+def _timed_reps(fn, reps):
+    """Median + spread of `reps` calls of fn() (fn must block on completion).
+    Returns (median_s, [times])."""
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), times
+
+
+def _emit(metric, value, unit, times, scale, **extras):
+    """One bench JSON line with the variance-aware spread field (value and
+    spread are `scale / time`)."""
+    import jax
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": unit,
+        "vs_baseline": None,  # reference publishes nothing for these
+        "platform": jax.devices()[0].platform,
+        "spread": {"min": round(scale / max(times), 3),
+                   "max": round(scale / min(times), 3),
+                   "reps": len(times)},
+        **extras,
+    }))
+
+
+def _capped(ds, cap, test_cap=256):
+    import dataclasses
+
+    from fedml_tpu.data.packing import PackedClients
+
+    return dataclasses.replace(
+        ds,
+        train=PackedClients(np.asarray(ds.train.x[:, :cap]),
+                            np.asarray(ds.train.y[:, :cap]),
+                            np.minimum(np.asarray(ds.train.counts), cap)),
+        test_global=(ds.test_global[0][:test_cap], ds.test_global[1][:test_cap]),
+    )
+
+
+def run_zoo_workload(workload: str):
+    """One measured round per non-FedAvg family (VERDICT r4 next #4); shapes
+    chosen to be representative (CIFAR geometry, the reference's default
+    models) while bounded enough to bench through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+    reps = max(1, int(os.environ.get("BENCH_REPS", 5)))
+
+    if workload == "fednas":
+        # one federated DARTS search round: 4 silos x 256 CIFAR samples,
+        # bi-level (weight+alpha) local search, default 8-channel 4-cell net
+        from fedml_tpu.algorithms.fednas import FedNASAPI
+
+        ds = _capped(load_dataset("cifar10", client_num_in_total=4,
+                                  partition_method="homo"), 256)
+        cfg = FedConfig(batch_size=64, epochs=1, lr=0.025, momentum=0.9,
+                        wd=3e-4, client_num_in_total=4, client_num_per_round=4,
+                        comm_round=1, dtype="bfloat16")
+        api = FedNASAPI(ds, cfg)
+        api.train_one_round(0)  # compile
+        dt, times = _timed_reps(lambda: api.train_one_round(1), reps)
+        samples = 4 * 256
+        _emit("fednas_search_samples_per_sec_per_chip", samples / dt,
+              "samples/s/chip", times, samples,
+              round_time_s=round(dt, 3))
+        return
+
+    if workload == "fedgkt":
+        # one GKT round (client feature phase + server KD phase), the
+        # reference's split ResNet-56 pair, 8 edge clients x 256 samples
+        from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+        from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
+
+        ds = _capped(load_dataset("cifar10", client_num_in_total=8,
+                                  partition_method="homo"), 256)
+        cfg = FedConfig(batch_size=64, epochs=1, lr=0.1,
+                        client_num_in_total=8, client_num_per_round=8,
+                        comm_round=1)
+        api = FedGKTAPI(ds, cfg, GKTClientResNet(output_dim=10),
+                        GKTServerResNet(output_dim=10), server_epochs=1)
+        x = jnp.asarray(ds.train.x)
+        y = jnp.asarray(ds.train.y)
+        counts = jnp.asarray(ds.train.counts)
+        # same mask expression as FedGKTAPI.train; KD targets via the API's
+        # own initializer so the bench can't drift from the real loop
+        mask = (jnp.arange(ds.train.n_max)[None, :] < counts[:, None]).astype(jnp.float32)
+        logits0 = api._init_server_logits()
+        key = jax.random.PRNGKey(0)
+        jax.block_until_ready(api.train_one_round(0, x, y, counts, mask, logits0, key))
+
+        def one():
+            jax.block_until_ready(
+                api.train_one_round(1, x, y, counts, mask, logits0, key))
+
+        dt, times = _timed_reps(one, reps)
+        samples = 8 * 256
+        _emit("fedgkt_round_samples_per_sec_per_chip", samples / dt,
+              "samples/s/chip", times, samples, round_time_s=round(dt, 3))
+        return
+
+    if workload == "fedseg":
+        # one FedSeg round: DeepLabV3+ (width 32) on pascal-shaped data,
+        # 4 clients — the heaviest per-sample model family in the repo
+        from fedml_tpu.algorithms.fedseg import FedSegAPI
+
+        ds = load_dataset("pascal_voc", client_num_in_total=4)
+        cfg = FedConfig(batch_size=8, epochs=1, lr=0.007,
+                        client_num_in_total=4, client_num_per_round=4,
+                        comm_round=1, frequency_of_the_test=1000)
+        api = FedSegAPI(ds, cfg)
+        api.train_one_round(0)  # compile
+        import jax as _jax
+
+        def one():
+            api.train_one_round(1)
+            _jax.block_until_ready(api._inner.global_variables)
+
+        dt, times = _timed_reps(one, reps)
+        samples = int(np.asarray(ds.train.counts).sum())
+        _emit("fedseg_round_samples_per_sec_per_chip", samples / dt,
+              "samples/s/chip", times, samples, round_time_s=round(dt, 3),
+              image_shape=list(np.asarray(ds.train.x[:1, 0]).shape[1:]))
+        return
+
+    if workload == "turboaggregate":
+        # the practitioner's first question: what does secure aggregation
+        # COST vs a plain weighted mean, at flagship model size
+        # (CNN_DropOut, 1,199,882 params) over 10 clients
+        from fedml_tpu.algorithms.turboaggregate import SecureAggregator
+        from fedml_tpu.core.trainer import ClassificationTrainer
+        from fedml_tpu.models.registry import create_model
+        from fedml_tpu.utils.pytree import tree_weighted_mean
+
+        trainer = ClassificationTrainer(create_model("cnn", output_dim=62))
+        gv = trainer.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28, 1)))
+        rng = np.random.RandomState(0)
+        n_clients = 10
+        trees = [jax.tree.map(lambda l: np.asarray(l) + rng.normal(
+            0, 1e-2, l.shape).astype(np.float32), gv["params"])
+            for _ in range(n_clients)]
+        weights = rng.randint(50, 200, n_clients).astype(np.float64)
+        agg = SecureAggregator(n_clients)
+        agg.secure_weighted_sum(trees, weights)  # warmup
+
+        dt_sec, times = _timed_reps(
+            lambda: agg.secure_weighted_sum(trees, weights), reps)
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *trees)
+        jplain = jax.jit(lambda s, w: tree_weighted_mean(s, w))
+        w32 = jnp.asarray(weights, jnp.float32)
+        jax.block_until_ready(jplain(stacked, w32))
+        dt_plain, _ = _timed_reps(
+            lambda: jax.block_until_ready(jplain(stacked, w32)), reps)
+        n_params = sum(int(np.asarray(l).size) for l in jax.tree.leaves(gv["params"]))
+        print(json.dumps({
+            "metric": "turboaggregate_secure_agg_overhead_x",
+            "value": round(dt_sec / dt_plain, 1),
+            "unit": "x_plain_aggregation",
+            "vs_baseline": None,
+            "platform": jax.devices()[0].platform,
+            "spread": {"min": round(min(times) / dt_plain, 1),
+                       "max": round(max(times) / dt_plain, 1),
+                       "reps": len(times)},
+            "secure_agg_s": round(dt_sec, 4),
+            "plain_agg_s": round(dt_plain, 5),
+            "n_params": n_params, "n_clients": n_clients,
+            "note": "secure path is host-side field arithmetic by design "
+                    "(Shamir shares never touch the accelerator)",
+        }))
+        return
+
+    raise SystemExit(f"unknown zoo workload {workload!r}")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -54,6 +244,8 @@ def main():
     from fedml_tpu.models.registry import create_model
 
     workload = os.environ.get("BENCH_WORKLOAD", "flagship")
+    if workload in ("fednas", "fedgkt", "fedseg", "turboaggregate"):
+        return run_zoo_workload(workload)
     model_name, out_dim, in_shape, d_n, d_bs, d_cpr = WORKLOADS[workload]
     clients_per_round = int(os.environ.get("BENCH_CLIENTS_PER_ROUND", d_cpr))
     n_per_client = int(os.environ.get("BENCH_SAMPLES_PER_CLIENT", d_n))
@@ -73,6 +265,8 @@ def main():
         batch_size=batch_size, epochs=epochs, lr=0.1, client_optimizer="sgd",
         client_num_per_round=clients_per_round, dtype=dtype,
         assume_full_clients=assume_full,
+        # one-matvec aggregation probe (docs/PERF.md agg section)
+        extra={"flat_agg": os.environ.get("BENCH_FLAT_AGG", "0") == "1"},
     )
     trainer = ClassificationTrainer(create_model(model_name, output_dim=out_dim, dtype=dtype))
     agg = make_aggregator("fedavg", cfg)
@@ -117,7 +311,7 @@ def main():
         return float(jnp.asarray(leaf).ravel()[0])
 
     scan_rounds = int(os.environ.get("BENCH_SCAN_ROUNDS", 20))
-    reps = max(1, int(os.environ.get("BENCH_REPS", 3)))  # best-of-N vs tunnel jitter
+    reps = max(1, int(os.environ.get("BENCH_REPS", 5)))  # median-of-N + spread
     fused = os.environ.get("BENCH_FUSED", "0") == "1"
     used_fused = False
     if scan_rounds > 1 and n_chips == 1:
@@ -162,30 +356,30 @@ def main():
             readback(gv)
         # (the fused probe above already served as its own warmup)
         calls = max(1, timed_rounds // scan_rounds)
-        best = float("inf")
+        rep_times = []
         for rep in range(reps):
             t0 = time.perf_counter()
             for r in range(calls):
                 gv, state, _ = multi(gv, state, x, y, counts,
                                      jax.random.fold_in(key, rep * calls + r))
             readback(gv)
-            best = min(best, time.perf_counter() - t0)
-        dt = best
+            rep_times.append(time.perf_counter() - t0)
         timed_rounds = calls * scan_rounds
     else:
         # warmup (compile)
         gv, state, _ = round_fn(gv, state, x, y, counts, key)
         readback(gv)
-        best = float("inf")
+        rep_times = []
         for rep in range(reps):
             t0 = time.perf_counter()
             for r in range(timed_rounds):
                 gv, state, _ = round_fn(gv, state, x, y, counts,
                                         jax.random.fold_in(key, rep * timed_rounds + r))
             readback(gv)
-            best = min(best, time.perf_counter() - t0)
-        dt = best
+            rep_times.append(time.perf_counter() - t0)
 
+    # variance-aware: median is the headline, min/max bound tunnel jitter
+    dt = statistics.median(rep_times)
     rounds_per_sec = timed_rounds / dt
     samples_per_round = clients_per_round * n_per_client * epochs
     samples_per_sec_per_chip = rounds_per_sec * samples_per_round / n_chips
@@ -212,6 +406,13 @@ def main():
         "platform": jax.devices()[0].platform,
         "fused_kernel": used_fused,
         "silo_threshold": silo_thr if silo_trainer is not None else 0,
+        "flat_agg": cfg.extra.get("flat_agg", False),
+        "spread": {
+            # samples/s implied by the slowest/fastest repetition
+            "min": round(timed_rounds / max(rep_times) * samples_per_round / n_chips, 2),
+            "max": round(timed_rounds / min(rep_times) * samples_per_round / n_chips, 2),
+            "reps": len(rep_times),
+        },
     }))
 
 

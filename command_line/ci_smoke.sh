@@ -156,4 +156,10 @@ python -m fedml_tpu.experiments.main_fedseg $COMMON --comm_round 1 --epochs 1 \
   --batch_size 4 --image_size 24 --model fcn
 assert_summary "Test/mIoU" 0.0 1.0
 
+echo "== examples/baseline config twin (har_hetero: har_subject + HAR_CNN + adam)"
+python -m fedml_tpu.experiments.fed_launch \
+  --config fedml_tpu/experiments/configs/baseline/har_hetero.yaml \
+  --override comm_round=1 epochs=1 run_dir="$RUN_DIR"
+assert_summary "Test/Acc" 0.0 1.0
+
 echo "ALL SMOKE TESTS PASSED"

@@ -30,6 +30,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from fedml_tpu.core.config import FedConfig
@@ -55,20 +56,52 @@ def tree_weighted_mean_psum(stacked_tree, weights, axis):
     return jax.tree.map(avg, stacked_tree)
 
 
+def tree_weighted_mean_flat(stacked_tree, weights):
+    """tree_weighted_mean as ONE [C] x [C, P] matvec over the raveled
+    concatenation of all leaves, split back afterwards.
+
+    The flagship round is tiny-op latency-bound (docs/PERF.md): the r4
+    ablation measured the per-leaf weighted mean at ~3% of the round
+    (flagship_ablation.json identity-agg rung). Collapsing the ~8 per-leaf
+    multiply-reduces into one fused contraction trades two P-sized copies
+    (concat in, slice out — HBM-cheap) for fewer dispatched ops. Opt in via
+    FedConfig.extra["flat_agg"]; measured A/B in docs/PERF.md."""
+    leaves, treedef = jax.tree.flatten(stacked_tree)
+    c = leaves[0].shape[0]
+    flat = jnp.concatenate(
+        [l.reshape(c, -1).astype(jnp.float32) for l in leaves], axis=1)
+    w = (weights / jnp.maximum(jnp.sum(weights), 1e-12)).astype(jnp.float32)
+    avg = w @ flat  # [P]
+    out, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape[1:])) if l.ndim > 1 else 1
+        out.append(avg[off:off + n].reshape(l.shape[1:]).astype(l.dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
 class FedAvgAggregator:
     """Sample-weighted mean over every variable collection (the reference
     averages the full state_dict, BN stats included)."""
 
     def __init__(self, cfg: FedConfig):
         self.cfg = cfg
+        self.flat = bool(cfg.extra.get("flat_agg", False))
 
     def init_state(self, global_variables) -> Any:
         return ()
 
     def __call__(self, global_variables, result, weights, rng, state):
+        if self.flat:
+            return tree_weighted_mean_flat(result.variables, weights), state
         return tree_weighted_mean(result.variables, weights), state
 
     def sharded(self, global_variables, result, weights, rng, state, axis):
+        if self.flat:
+            raise ValueError(
+                "flat_agg is a single-chip latency probe (and a measured "
+                "negative, docs/PERF.md) — it has no sharded rule; drop "
+                "extra['flat_agg'] for shard_map runs")
         return tree_weighted_mean_psum(result.variables, weights, axis), state
 
 

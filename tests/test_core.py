@@ -71,6 +71,30 @@ def test_tree_weighted_mean_matches_manual():
     np.testing.assert_allclose(out["a"], (1 * np.array([1, 2.0]) + 1 * np.array([3, 4.0]) + 2 * np.array([5, 6.0])) / 4)
 
 
+def test_tree_weighted_mean_flat_equals_per_leaf():
+    """The one-matvec aggregation (aggregators.tree_weighted_mean_flat, the
+    r5 latency probe) must equal the per-leaf weighted mean on a mixed-rank
+    tree, including rank-1 leaves and non-f32 dtypes."""
+    from fedml_tpu.algorithms.aggregators import tree_weighted_mean_flat
+
+    rng = np.random.RandomState(3)
+    stacked = {
+        "k": jnp.asarray(rng.rand(6, 4, 3).astype(np.float32)),
+        "b": jnp.asarray(rng.rand(6, 5).astype(np.float32)),
+        "s": jnp.asarray(rng.rand(6).astype(np.float32)),
+        "h": jnp.asarray(rng.rand(6, 2).astype(np.float16)),
+    }
+    w = jnp.asarray(rng.randint(1, 9, 6).astype(np.float32))
+    want = tree_weighted_mean(stacked, w)
+    got = tree_weighted_mean_flat(stacked, w)
+    for k in stacked:
+        assert got[k].dtype == stacked[k].dtype
+        np.testing.assert_allclose(np.asarray(got[k], np.float32),
+                                   np.asarray(want[k], np.float32),
+                                   rtol=2e-3 if k == "h" else 1e-6,
+                                   atol=1e-6)
+
+
 def test_tree_where_selects():
     a = {"x": jnp.ones(3)}
     b = {"x": jnp.zeros(3)}

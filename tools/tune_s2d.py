@@ -1,0 +1,138 @@
+"""s2d accuracy tuning sweep (VERDICT r4 next #2).
+
+r4 measured resnet56_s2d at 4.4x throughput but -0.10 Test/Acc at matched
+rounds with the baseline's lr transplanted unchanged (docs/PERF.md). This
+sweep runs the surrogate-CIFAR 30-round protocol (10 silos, 5000
+samples/silo, E=2, bs 64, bf16) over an lr grid for BOTH models, records
+accuracy trajectories + measured per-round wall time, and emits the
+matched-WALL-CLOCK comparison the 4.4x headline needs to be honest.
+
+Run on the real TPU: python tools/tune_s2d.py
+Writes docs/s2d_tuning.json; prints one JSON line per (model, lr) plus the
+crossover table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("BENCH_DTYPE", "bfloat16")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from fedml_tpu.utils.cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+from fedml_tpu.algorithms.aggregators import make_aggregator  # noqa: E402
+from fedml_tpu.algorithms.engine import (  # noqa: E402
+    build_eval_fn,
+    build_multi_round_fn,
+)
+from fedml_tpu.core.config import FedConfig  # noqa: E402
+from fedml_tpu.core.trainer import ClassificationTrainer  # noqa: E402
+from fedml_tpu.data.packing import pack_eval_batches  # noqa: E402
+from fedml_tpu.data.registry import load_dataset  # noqa: E402
+from fedml_tpu.models.registry import create_model  # noqa: E402
+
+SILOS, ROUNDS, SEG, E, BS = 10, 30, 5, 2, 64
+
+
+def run_one(model_name: str, lr: float, ds, test_batches):
+    cfg = FedConfig(batch_size=BS, epochs=E, lr=lr, client_optimizer="sgd",
+                    client_num_in_total=SILOS, client_num_per_round=SILOS,
+                    dtype="bfloat16", assume_full_clients=True)
+    trainer = ClassificationTrainer(
+        create_model(model_name, output_dim=10, dtype="bfloat16"))
+    agg = make_aggregator("fedavg", cfg)
+    multi = build_multi_round_fn(trainer, cfg, agg, SEG)
+    eval_fn = build_eval_fn(trainer)
+
+    x = jnp.asarray(ds.train.x)
+    y = jnp.asarray(ds.train.y)
+    counts = jnp.asarray(ds.train.counts)
+    gv = trainer.init(jax.random.PRNGKey(0), x[:1, 0])
+    st = agg.init_state(gv)
+    key = jax.random.PRNGKey(7)
+
+    # compile outside timing
+    gv_w, st_w, _ = multi(gv, st, x, y, counts, key)
+    jax.block_until_ready(jax.tree.leaves(gv_w)[0])
+
+    traj, t_train = [], 0.0
+    gv_c, st_c = gv, st
+    for seg in range(ROUNDS // SEG):
+        t0 = time.perf_counter()
+        gv_c, st_c, _ = multi(gv_c, st_c, x, y, counts,
+                              jax.random.fold_in(key, seg))
+        float(np.asarray(jax.tree.leaves(gv_c)[0]).ravel()[0])
+        t_train += time.perf_counter() - t0
+        m = eval_fn(gv_c, *test_batches)
+        acc = float(m["test_correct"]) / max(float(m["test_total"]), 1.0)
+        traj.append({"round": (seg + 1) * SEG, "acc": round(acc, 4)})
+    rec = {"model": model_name, "lr": lr, "rounds": ROUNDS,
+           "round_time_s": round(t_train / ROUNDS, 4),
+           "final_acc": traj[-1]["acc"], "trajectory": traj}
+    print(json.dumps(rec))
+    return rec
+
+
+def main():
+    print(f"# devices: {jax.devices()}")
+    ds = load_dataset("cifar10", client_num_in_total=SILOS,
+                      partition_method="homo", seed=0)
+    # trim every silo to a batch multiple so assume_full_clients holds
+    import dataclasses
+
+    from fedml_tpu.data.packing import PackedClients
+
+    cap = (int(np.asarray(ds.train.counts).min()) // BS) * BS
+    ds = dataclasses.replace(
+        ds, train=PackedClients(np.asarray(ds.train.x[:, :cap]),
+                                np.asarray(ds.train.y[:, :cap]),
+                                np.full(SILOS, cap, np.int64)))
+    print(f"# samples/silo: {cap}")
+    test_batches = pack_eval_batches(ds.test_global[0][:2000],
+                                     ds.test_global[1][:2000], 200)
+    test_batches = tuple(jnp.asarray(b) for b in test_batches)
+
+    out = []
+    for lr in (0.1, 0.2, 0.4):
+        out.append(run_one("resnet56", lr, ds, test_batches))
+    for lr in (0.1, 0.2, 0.4, 0.8):
+        out.append(run_one("resnet56_s2d", lr, ds, test_batches))
+
+    # matched-wall-clock crossover: best config per model; how does acc
+    # compare when s2d is given the SAME wall-clock (i.e. more rounds)?
+    base = max((r for r in out if r["model"] == "resnet56"),
+               key=lambda r: r["final_acc"])
+    s2d = max((r for r in out if r["model"] == "resnet56_s2d"),
+              key=lambda r: r["final_acc"])
+    speed = base["round_time_s"] / s2d["round_time_s"]
+    cross = []
+    for p in base["trajectory"]:
+        budget_s = p["round"] * base["round_time_s"]
+        s2d_rounds = budget_s / s2d["round_time_s"]
+        # s2d acc at that budget: last trajectory point it reached
+        reached = [q for q in s2d["trajectory"] if q["round"] <= s2d_rounds]
+        cross.append({"wall_clock_s": round(budget_s, 1),
+                      "baseline_acc": p["acc"],
+                      "s2d_acc": reached[-1]["acc"] if reached else None,
+                      "s2d_rounds": round(s2d_rounds, 1)})
+    summary = {"speedup_rounds_per_s": round(speed, 2),
+               "best_baseline": {k: base[k] for k in ("lr", "final_acc", "round_time_s")},
+               "best_s2d": {k: s2d[k] for k in ("lr", "final_acc", "round_time_s")},
+               "matched_wall_clock": cross}
+    print(json.dumps(summary))
+    with open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                           "s2d_tuning.json"), "w") as f:
+        json.dump({"runs": out, "summary": summary}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
