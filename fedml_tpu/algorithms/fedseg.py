@@ -177,7 +177,8 @@ class FedSegAPI:
         if model_trainer is None:
             from fedml_tpu.models.registry import create_model
 
-            module = create_model("deeplab", output_dim=dataset.class_num)
+            module = create_model("deeplab", output_dim=dataset.class_num,
+                                  dtype=config.dtype)
             model_trainer = SegmentationTrainer(module, loss_type=loss_type)
         self.trainer = model_trainer
         self._inner = FedAvgAPI(dataset, config, model_trainer,

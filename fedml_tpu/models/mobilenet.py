@@ -14,14 +14,17 @@ import jax.numpy as jnp
 class _DWSep(nn.Module):
     out_ch: int
     stride: int = 1
+    dtype: object = None  # compute dtype; BN math stays f32 via promotion
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         ch = x.shape[-1]
         x = nn.Conv(ch, (3, 3), (self.stride, self.stride), padding=1,
-                    feature_group_count=ch, use_bias=False, name="depthwise")(x)
+                    feature_group_count=ch, use_bias=False, dtype=self.dtype,
+                    name="depthwise")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="dw_bn")(x))
-        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="pointwise")(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pointwise")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="pw_bn")(x))
         return x
 
@@ -29,17 +32,21 @@ class _DWSep(nn.Module):
 class MobileNet(nn.Module):
     output_dim: int = 100
     alpha: float = 1.0
+    # compute dtype for convs/fc (bf16 = MXU-native; same policy as the
+    # CIFAR ResNets — docs/PERF.md r5 dtype section)
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         def c(n):
             return int(n * self.alpha)
 
-        x = nn.Conv(c(32), (3, 3), padding=1, use_bias=False, name="stem")(x)
+        x = nn.Conv(c(32), (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name="stem")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="stem_bn")(x))
         plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
                 (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
         for i, (ch, s) in enumerate(plan):
-            x = _DWSep(c(ch), s, name=f"dw{i}")(x, train)
+            x = _DWSep(c(ch), s, dtype=self.dtype, name=f"dw{i}")(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.output_dim, name="fc")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(x)

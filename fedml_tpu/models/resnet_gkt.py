@@ -20,16 +20,18 @@ class GKTClientResNet(nn.Module):
 
     output_dim: int = 10
     num_blocks: int = 1
+    dtype: object = None  # compute dtype (bf16 = MXU-native); norm math f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
         x = nn.relu(_Norm()(x, train))
         for _ in range(self.num_blocks):
-            x = BasicBlock(planes=16)(x, train)
+            x = BasicBlock(planes=16, dtype=self.dtype)(x, train)
         features = x  # [b, h, w, 16] shipped to the server
         pooled = jnp.mean(x, axis=(1, 2))
-        logits = nn.Dense(self.output_dim, name="fc")(pooled)
+        logits = nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(pooled)
         return logits, features
 
 
@@ -39,6 +41,7 @@ class GKTServerResNet(nn.Module):
 
     output_dim: int = 10
     layers: Sequence[int] = (5, 6, 6)
+    dtype: object = None
 
     @nn.compact
     def __call__(self, features, train: bool = False):
@@ -46,6 +49,6 @@ class GKTServerResNet(nn.Module):
         for stage, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                x = Bottleneck(planes=planes, stride=stride)(x, train)
+                x = Bottleneck(planes=planes, stride=stride, dtype=self.dtype)(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.output_dim, name="fc")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(x)

@@ -27,16 +27,18 @@ class _SepConv(nn.Module):
     out_ch: int
     stride: int = 1
     dilation: int = 1
+    dtype: object = None  # compute dtype; BN math f32 via promotion
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         ch = x.shape[-1]
         x = nn.Conv(ch, (3, 3), (self.stride, self.stride), padding="SAME",
                     feature_group_count=ch, kernel_dilation=self.dilation,
-                    use_bias=False, name="dw")(x)
+                    use_bias=False, dtype=self.dtype, name="dw")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  name="dw_bn")(x))
-        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="pw")(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pw")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  name="pw_bn")(x))
         return x
@@ -47,6 +49,7 @@ class _ASPP(nn.Module):
     global image pooling, concatenated and projected."""
     out_ch: int = 128
     rates: tuple = (6, 12, 18)
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -55,17 +58,18 @@ class _ASPP(nn.Module):
                                         momentum=0.9, name=name)(h))
 
         branches = [bn(nn.Conv(self.out_ch, (1, 1), use_bias=False,
-                               name="b0")(x), "b0_bn")]
+                               dtype=self.dtype, name="b0")(x), "b0_bn")]
         for i, r in enumerate(self.rates):
             branches.append(bn(nn.Conv(self.out_ch, (3, 3), padding="SAME",
                                        kernel_dilation=r, use_bias=False,
+                                       dtype=self.dtype,
                                        name=f"b{i + 1}")(x), f"b{i + 1}_bn"))
         pool = jnp.mean(x, axis=(1, 2), keepdims=True)
-        pool = bn(nn.Conv(self.out_ch, (1, 1), use_bias=False,
+        pool = bn(nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
                           name="img_pool")(pool), "img_pool_bn")
         pool = jnp.broadcast_to(pool, branches[0].shape)
         h = jnp.concatenate(branches + [pool], axis=-1)
-        h = bn(nn.Conv(self.out_ch, (1, 1), use_bias=False,
+        h = bn(nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
                        name="project")(h), "project_bn")
         return h
 
@@ -80,36 +84,42 @@ class DeepLabV3Plus(nn.Module):
     stride 4). Returns per-pixel logits at input resolution [b, h, w, C]."""
     output_dim: int = 21
     width: int = 32
+    # compute dtype for the backbone convs (bf16 = MXU-native; BN math f32
+    # via flax promotion). Unlike the CIFAR ResNets' fc, the per-pixel
+    # classifier head stays f32 — segmentation logits feed per-pixel CE
+    # where bf16 resolution costs accuracy for negligible time.
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        w = self.width
+        w, dt = self.width, self.dtype
         in_hw = x.shape[1:3]
         # stem: stride 2
         h = nn.Conv(w, (3, 3), (2, 2), padding="SAME", use_bias=False,
-                    name="stem")(x)
+                    dtype=dt, name="stem")(x)
         h = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  name="stem_bn")(h))
         # stage 1: stride 4 — the decoder's low-level skip source
-        h = _SepConv(2 * w, stride=2, name="stage1a")(h, train)
-        h = _SepConv(2 * w, name="stage1b")(h, train)
+        h = _SepConv(2 * w, stride=2, dtype=dt, name="stage1a")(h, train)
+        h = _SepConv(2 * w, dtype=dt, name="stage1b")(h, train)
         low_level = h
         # stages 2-3: stride 16
-        h = _SepConv(4 * w, stride=2, name="stage2a")(h, train)
-        h = _SepConv(4 * w, name="stage2b")(h, train)
-        h = _SepConv(8 * w, stride=2, name="stage3a")(h, train)
+        h = _SepConv(4 * w, stride=2, dtype=dt, name="stage2a")(h, train)
+        h = _SepConv(4 * w, dtype=dt, name="stage2b")(h, train)
+        h = _SepConv(8 * w, stride=2, dtype=dt, name="stage3a")(h, train)
         # atrous residual stage keeps stride 16 with growing receptive field
-        h = _SepConv(8 * w, dilation=2, name="stage3b")(h, train)
-        h = _ASPP(4 * w, name="aspp")(h, train)
+        h = _SepConv(8 * w, dilation=2, dtype=dt, name="stage3b")(h, train)
+        h = _ASPP(4 * w, dtype=dt, name="aspp")(h, train)
 
         # decoder: upsample x4, concat reduced low-level features, refine
         h = _resize(h, low_level.shape[1:3])
-        ll = nn.Conv(w, (1, 1), use_bias=False, name="ll_reduce")(low_level)
+        ll = nn.Conv(w, (1, 1), use_bias=False, dtype=dt,
+                     name="ll_reduce")(low_level)
         ll = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                   name="ll_bn")(ll))
-        h = jnp.concatenate([h, ll], axis=-1)
-        h = _SepConv(4 * w, name="dec1")(h, train)
-        h = _SepConv(4 * w, name="dec2")(h, train)
+        h = jnp.concatenate([h, ll.astype(h.dtype)], axis=-1)
+        h = _SepConv(4 * w, dtype=dt, name="dec1")(h, train)
+        h = _SepConv(4 * w, dtype=dt, name="dec2")(h, train)
         h = nn.Conv(self.output_dim, (1, 1), name="classifier")(h)
         return _resize(h, in_hw)  # [b, h, w, classes]
 

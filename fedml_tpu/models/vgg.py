@@ -16,6 +16,7 @@ CFG = {
 class VGG(nn.Module):
     variant: str = "vgg11"
     output_dim: int = 10
+    dtype: object = None  # compute dtype (bf16 = MXU-native); BN math f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -23,7 +24,7 @@ class VGG(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(v, (3, 3), padding=1, name=f"conv{i}")(x)
+                x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype, name=f"conv{i}")(x)
                 x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name=f"bn{i}")(x))
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.output_dim, name="classifier")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="classifier")(x)

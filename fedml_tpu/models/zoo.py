@@ -84,7 +84,8 @@ def _resnet18_gn(output_dim, **kw):
 
 @register_model("mobilenet")
 def _mobilenet(output_dim, **kw):
-    return MobileNet(output_dim=output_dim, alpha=kw.get("alpha", 1.0))
+    return MobileNet(output_dim=output_dim, alpha=kw.get("alpha", 1.0),
+                     dtype=_compute_dtype(kw))
 
 
 @register_model("rnn")
@@ -101,12 +102,12 @@ def _rnn_so(output_dim, **kw):
 
 @register_model("vgg11")
 def _vgg11(output_dim, **kw):
-    return VGG(variant="vgg11", output_dim=output_dim)
+    return VGG(variant="vgg11", output_dim=output_dim, dtype=_compute_dtype(kw))
 
 
 @register_model("vgg16")
 def _vgg16(output_dim, **kw):
-    return VGG(variant="vgg16", output_dim=output_dim)
+    return VGG(variant="vgg16", output_dim=output_dim, dtype=_compute_dtype(kw))
 
 
 @register_model("deeplab")
@@ -115,7 +116,8 @@ def _deeplab(output_dim, **kw):
     # bundled model; DeepLabV3+ is the upstream family it targets)
     from fedml_tpu.models.segmentation import DeepLabV3Plus
 
-    return DeepLabV3Plus(output_dim=output_dim, width=kw.get("width", 32))
+    return DeepLabV3Plus(output_dim=output_dim, width=kw.get("width", 32),
+                         dtype=_compute_dtype(kw))
 
 
 @register_model("fcn")
