@@ -175,3 +175,20 @@ def test_fedseg_checkpoint_resume_exact(tmp_path):
                     jax.tree.leaves(resumed.global_variables)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
     assert len(resumed.history) == 3
+
+
+def test_fedseg_default_model_honors_config_dtype():
+    """FedSegAPI's default DeepLab build must respect config.dtype (the r5
+    silent-f32 lesson: an absent knob means f32 regardless of BENCH_DTYPE)."""
+    from fedml_tpu.algorithms.fedseg import FedSegAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.registry import load_dataset
+
+    ds = load_dataset("pascal_voc", client_num_in_total=2, image_size=16)
+    cfg = FedConfig(batch_size=2, epochs=1, lr=0.01, comm_round=1,
+                    client_num_in_total=2, client_num_per_round=2,
+                    dtype="bfloat16")
+    api = FedSegAPI(ds, cfg)
+    assert api.trainer.module.dtype == jnp.bfloat16
+    cfg32 = cfg.replace(dtype="float32")
+    assert FedSegAPI(ds, cfg32).trainer.module.dtype is None

@@ -128,6 +128,30 @@ def test_silo_momentum_optimizer_exact_per_silo():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_silo_round_with_fednova_aggregator():
+    """The silo path's LocalResult contract (stacked variables + per-silo
+    num_steps) must satisfy non-FedAvg aggregators too — FedNova consumes
+    num_steps for tau normalization. RAGGED counts on purpose: with uniform
+    tau FedNova collapses algebraically to FedAvg and a wrong-but-uniform
+    num_steps would pass unnoticed; differing per-silo step counts make the
+    tau normalization load-bearing."""
+    plain, silo = _models()
+    x, y, counts = _data()
+    counts = jnp.asarray([8, 5, 3], jnp.int32)  # 2 / 2 / 1 real batches
+    cfg = FedConfig(batch_size=4, epochs=2, lr=0.1, client_optimizer="sgd",
+                    client_num_per_round=3, assume_full_clients=False)
+    agg = make_aggregator("fednova", cfg)
+    tr_plain, tr_silo = ClassificationTrainer(plain), ClassificationTrainer(silo)
+    gv = tr_plain.init(jax.random.PRNGKey(2), x[0, :1])
+    st = agg.init_state(gv)
+    rng = jax.random.PRNGKey(5)
+    gv_p, _, _ = build_round_fn(tr_plain, cfg, agg)(gv, st, x, y, counts, rng)
+    gv_s, _, _ = build_silo_round_fn(tr_silo, cfg, agg)(gv, st, x, y, counts, rng)
+    for a, b in zip(jax.tree.leaves(gv_p), jax.tree.leaves(gv_s)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_silo_multi_round_matches_engine_multi_round():
     """The scan-amortized silo path (what bench.py runs) matches the
     engine's multi-round scan, including in-graph client sampling."""
