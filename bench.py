@@ -40,10 +40,15 @@ WORKLOADS = {
     # variant, so accuracy targets need re-validation before comparisons
     "cross_silo_s2d": ("resnet56_s2d", 10, (32, 32, 3), 256, 64, 10),
     "cross_silo_mobilenet": ("mobilenet", 10, (32, 32, 3), 256, 64, 10),
+    # MobileNetV3-small (SE blocks + hardswish) — the registry-wide dtype
+    # pipeline reaches it as of this round; rung exists to A/B bf16 there
+    "cross_silo_mobilenet_v3": ("mobilenet_v3", 10, (32, 32, 3), 256, 64, 10),
     # BASELINE.md's published cross-silo config is E=20, bs 64, 5000
     # samples/silo (CIFAR/10 silos) — run either cross_silo* workload with
     # BENCH_EPOCHS=20 BENCH_SAMPLES_PER_CLIENT=5000 BENCH_SCAN_ROUNDS=1
-    # BENCH_ROUNDS=1 to measure it (docs/PERF.md §cross-silo).
+    # BENCH_ROUNDS=1 to measure it (docs/PERF.md §cross-silo). E >= 10
+    # auto-enables chunked donated-carry dispatch (BENCH_EPOCH_CHUNK below)
+    # so the round is short-dispatch-safe and MEASURED, not extrapolated.
 }
 
 
@@ -257,6 +262,17 @@ def main():
     epochs = int(os.environ.get("BENCH_EPOCHS", 1))
     batch_size = int(os.environ.get("BENCH_BATCH_SIZE", d_bs))
     timed_rounds = int(os.environ.get("BENCH_ROUNDS", 60))
+    # chunked donated-carry dispatch (engine.build_chunked_round_runner):
+    # split an E-epoch round into ceil(E/chunk) short device programs so
+    # long-E rounds (the reference cross-silo config is E=20) fit under
+    # single-dispatch watchdogs and BENCH_EPOCHS=20 measures a REAL round
+    # instead of extrapolating. Auto-on at chunk=5 for E >= 10; set
+    # BENCH_EPOCH_CHUNK=0 to force the monolithic scan, or any K >= 1 to
+    # pick the chunk size. Trajectories are bit-identical either way
+    # (tests/test_chunked_dispatch.py).
+    epoch_chunk = int(os.environ.get("BENCH_EPOCH_CHUNK",
+                                     "5" if epochs >= 10 else "0"))
+    epoch_chunk = min(epoch_chunk, epochs)
 
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")  # MXU-native default
     # the bench's packed rows are full by construction (every count ==
@@ -282,6 +298,15 @@ def main():
     silo_thr = int(os.environ.get(
         "BENCH_SILO_THRESHOLD",
         "32" if workload == "cross_silo" and n_chips == 1 else "0"))
+    if epoch_chunk > 0 and n_chips == 1 and silo_thr > 0:
+        # the silo-grouped update is grad-outside-vmap (custom_vmap does not
+        # compose as vmap(grad)), so it keeps the monolithic scan — chunking
+        # wins the long-E watchdog fight, silo-grouping wins MXU utilization;
+        # they are mutually exclusive execution shapes today
+        print("# BENCH_EPOCH_CHUNK set: silo-grouped lowering disabled for "
+              "this run (chunked dispatch uses the vmap engine)",
+              file=__import__("sys").stderr)
+        silo_thr = 0
     silo_trainer = None
     if silo_thr > 0 and n_chips == 1 and hasattr(trainer.module, "silo_threshold"):
         from fedml_tpu.algorithms.silo_grouped import silo_trainer as make_silo
@@ -293,6 +318,10 @@ def main():
 
         clients_per_round = ((clients_per_round + n_chips - 1) // n_chips) * n_chips
         round_fn = build_sharded_round_fn(trainer, cfg, agg, make_mesh())
+    elif epoch_chunk > 0:
+        from fedml_tpu.algorithms.engine import build_chunked_round_runner
+
+        round_fn = build_chunked_round_runner(trainer, cfg, agg, epoch_chunk)
     elif silo_trainer is not None:
         from fedml_tpu.algorithms.silo_grouped import build_silo_round_fn
 
@@ -319,7 +348,7 @@ def main():
     reps = max(1, int(os.environ.get("BENCH_REPS", 5)))  # median-of-N + spread
     fused = os.environ.get("BENCH_FUSED", "0") == "1"
     used_fused = False
-    if scan_rounds > 1 and n_chips == 1:
+    if scan_rounds > 1 and n_chips == 1 and epoch_chunk == 0:
         # dispatch-amortized fast path: R rounds per jit call (in-graph sampling)
         from fedml_tpu.algorithms.engine import build_multi_round_fn
 
@@ -397,6 +426,7 @@ def main():
         "cross_silo": "fedavg_cifar_resnet56_samples_per_sec_per_chip",
         "cross_silo_s2d": "fedavg_cifar_resnet56_s2d_samples_per_sec_per_chip",
         "cross_silo_mobilenet": "fedavg_cifar_mobilenet_samples_per_sec_per_chip",
+        "cross_silo_mobilenet_v3": "fedavg_cifar_mobilenet_v3_samples_per_sec_per_chip",
     }[workload]
     print(json.dumps({
         "metric": metric_name,
@@ -404,6 +434,9 @@ def main():
         "unit": "samples/s/chip",
         "vs_baseline": vs_baseline,
         "rounds_per_sec": round(rounds_per_sec, 4),
+        "round_time_s": round(dt / timed_rounds, 3),
+        "epochs": epochs,
+        "epoch_chunk": epoch_chunk,
         "clients_per_round": clients_per_round,
         "samples_per_client": n_per_client,
         "batch_size": batch_size,

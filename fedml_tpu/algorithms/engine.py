@@ -134,6 +134,125 @@ def _merge_variables(variables, new_params, new_state):
     return out
 
 
+def _build_epoch_fn(trainer, cfg: FedConfig, opt) -> Callable:
+    """Shared one-local-epoch body: epoch_fn(global_params, carry, x, y,
+    count, erng) -> (carry, auxs) with carry = (variables, opt_state, steps).
+
+    Both the monolithic E-epoch scan (build_local_update) and the chunked
+    donated-carry dispatch (build_chunked_round_runner) scan this same
+    function, so the two execution shapes cannot drift apart numerically.
+    """
+    mu = cfg.fedprox_mu
+    # Stateless-optimizer fast path: with plain SGD (no momentum/wd) a zero
+    # gradient IS a no-op update — masked losses give exactly-zero grads on
+    # all-padding batches (mask is a constant factor of the loss), so the
+    # per-leaf tree_where select machinery is dead weight. The round profile
+    # is tiny-op latency-bound (~56 ops/step at ~20us), so dropping ~2 selects
+    # per param leaf per step is a real win; model state (e.g. BatchNorm
+    # running stats) is still masked because padded samples DO pollute it.
+    # FedProx disqualifies the fast path: the proximal term mu*(p - g) is
+    # nonzero even when the data-loss gradient is masked to zero, so an
+    # all-padding batch WOULD take a prox-only step toward the global params
+    # (keep this criterion identical to algorithms/silo_grouped.py).
+    stateless_opt = (cfg.client_optimizer == "sgd" and not cfg.momentum
+                     and not cfg.wd and cfg.fedprox_mu == 0.0)
+    full = cfg.assume_full_clients
+
+    def epoch_fn(global_params, carry, x, y, count, erng):
+        n_max = x.shape[0]
+        b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
+        nb = math.ceil(n_max / b)
+        n_pad = nb * b
+        if full and n_pad != n_max:
+            raise ValueError(
+                f"assume_full_clients requires n_max ({n_max}) % batch_size "
+                f"({b}) == 0 — padded batches would be trained unmasked")
+
+        variables, opt_state, steps = carry
+        shuffle_rng, step_rng = jax.random.split(erng)
+        if cfg.shuffle and full:
+            # all rows valid: argsort(u) IS argsort(where(valid,u,inf))
+            perm = jnp.argsort(jax.random.uniform(shuffle_rng, (n_max,)))
+        elif cfg.shuffle:
+            u = jax.random.uniform(shuffle_rng, (n_max,))
+            valid = jnp.arange(n_max) < count
+            perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
+        else:
+            # fixed-order epochs: data is packed valid-prefix-first, so
+            # identity order == torch DataLoader(shuffle=False)
+            perm = jnp.arange(n_max)
+        if n_pad > n_max:
+            perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
+        # ONE epoch-level gather instead of a gather per step: scan then
+        # slices contiguous batches from the pre-permuted copy (dispatch-
+        # latency-bound regime — fewer, larger ops win).
+        xe = jnp.take(x, perm, axis=0).reshape((nb, b) + x.shape[1:])
+        ye = jnp.take(y, perm, axis=0).reshape((nb, b) + y.shape[1:])
+        if full:
+            # literal ones: XLA folds the mask multiplies away and the
+            # all-padding-batch selects below turn statically true
+            batch_valid = jnp.ones((nb, b), bool)
+        else:
+            batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
+
+        def step_body(carry, scan_in):
+            variables, opt_state, steps = carry
+            bx, by, bvalid, srng = scan_in
+            batch = {
+                "x": bx,
+                "y": by,
+                "mask": bvalid.astype(jnp.float32),
+            }
+
+            def loss_wrap(params):
+                vars_in = _merge_variables(variables, params, {})
+                loss, (new_state, aux) = trainer.loss_fn(vars_in, batch, srng, True)
+                if mu > 0.0:
+                    # FedProx proximal term mu/2 * ||w - w_global||^2
+                    # (reference fednova.py:124-126 applies it in-optimizer)
+                    sq = sum(
+                        jnp.sum(jnp.square(p - g))
+                        for p, g in zip(jax.tree.leaves(params), jax.tree.leaves(global_params))
+                    )
+                    loss = loss + 0.5 * mu * sq
+                return loss, (new_state, aux)
+
+            grad_fn = jax.value_and_grad(loss_wrap, has_aux=True)
+            (_, (new_state, aux)), grads = grad_fn(variables["params"])
+            updates, new_opt_state = opt.update(grads, opt_state, variables["params"])
+            new_params = optax.apply_updates(variables["params"], updates)
+            if full:
+                # every batch has data: the no-op-step machinery vanishes
+                variables = _merge_variables(variables, new_params, new_state)
+                opt_state = new_opt_state
+                steps = steps + 1
+                return (variables, opt_state, steps), aux
+            has_data = jnp.any(bvalid)
+            if stateless_opt:
+                # zero grads already make the update a no-op; only guard
+                # mutable model state (BN stats) against padded samples
+                variables = _merge_variables(
+                    variables, new_params,
+                    tree_where(has_data, new_state,
+                               {k: variables[k] for k in new_state}),
+                )
+                opt_state = new_opt_state
+            else:
+                new_vars = _merge_variables(variables, new_params, new_state)
+                variables = tree_where(has_data, new_vars, variables)
+                opt_state = tree_where(has_data, new_opt_state, opt_state)
+            steps = steps + has_data.astype(jnp.int32)
+            return (variables, opt_state, steps), aux
+
+        srngs = jax.random.split(step_rng, nb)
+        (variables, opt_state, steps), auxs = jax.lax.scan(
+            step_body, (variables, opt_state, steps), (xe, ye, batch_valid, srngs)
+        )
+        return (variables, opt_state, steps), auxs
+
+    return epoch_fn
+
+
 def build_local_update(trainer, cfg: FedConfig, pvary_axes: tuple = ()) -> Callable:
     """Returns local_update(global_variables, x, y, count, rng) -> LocalResult.
 
@@ -152,115 +271,17 @@ def build_local_update(trainer, cfg: FedConfig, pvary_axes: tuple = ()) -> Calla
     if cfg.epochs < 1:
         raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
     opt = make_local_optimizer(cfg)
-    mu = cfg.fedprox_mu
-    # Stateless-optimizer fast path: with plain SGD (no momentum/wd) a zero
-    # gradient IS a no-op update — masked losses give exactly-zero grads on
-    # all-padding batches (mask is a constant factor of the loss), so the
-    # per-leaf tree_where select machinery is dead weight. The round profile
-    # is tiny-op latency-bound (~56 ops/step at ~20us), so dropping ~2 selects
-    # per param leaf per step is a real win; model state (e.g. BatchNorm
-    # running stats) is still masked because padded samples DO pollute it.
-    stateless_opt = cfg.client_optimizer == "sgd" and not cfg.momentum and not cfg.wd
+    epoch_fn = _build_epoch_fn(trainer, cfg, opt)
 
     def local_update(global_variables, x, y, count, rng) -> LocalResult:
         if pvary_axes:
             global_variables = jax.lax.pcast(
                 global_variables, pvary_axes, to="varying")
-        n_max = x.shape[0]
-        b = n_max if cfg.batch_size <= 0 else min(cfg.batch_size, n_max)
-        nb = math.ceil(n_max / b)
-        n_pad = nb * b
         global_params = global_variables["params"]
         opt_state = opt.init(global_params)
 
-        full = cfg.assume_full_clients
-        if full and n_pad != n_max:
-            raise ValueError(
-                f"assume_full_clients requires n_max ({n_max}) % batch_size "
-                f"({b}) == 0 — padded batches would be trained unmasked")
-
         def epoch_body(carry, erng):
-            variables, opt_state, steps = carry
-            shuffle_rng, step_rng = jax.random.split(erng)
-            if cfg.shuffle and full:
-                # all rows valid: argsort(u) IS argsort(where(valid,u,inf))
-                perm = jnp.argsort(jax.random.uniform(shuffle_rng, (n_max,)))
-            elif cfg.shuffle:
-                u = jax.random.uniform(shuffle_rng, (n_max,))
-                valid = jnp.arange(n_max) < count
-                perm = jnp.argsort(jnp.where(valid, u, jnp.inf))
-            else:
-                # fixed-order epochs: data is packed valid-prefix-first, so
-                # identity order == torch DataLoader(shuffle=False)
-                perm = jnp.arange(n_max)
-            if n_pad > n_max:
-                perm = jnp.concatenate([perm, jnp.zeros(n_pad - n_max, perm.dtype)])
-            # ONE epoch-level gather instead of a gather per step: scan then
-            # slices contiguous batches from the pre-permuted copy (dispatch-
-            # latency-bound regime — fewer, larger ops win).
-            xe = jnp.take(x, perm, axis=0).reshape((nb, b) + x.shape[1:])
-            ye = jnp.take(y, perm, axis=0).reshape((nb, b) + y.shape[1:])
-            if full:
-                # literal ones: XLA folds the mask multiplies away and the
-                # all-padding-batch selects below turn statically true
-                batch_valid = jnp.ones((nb, b), bool)
-            else:
-                batch_valid = (jnp.arange(n_pad) < count).reshape(nb, b)
-
-            def step_body(carry, scan_in):
-                variables, opt_state, steps = carry
-                bx, by, bvalid, srng = scan_in
-                batch = {
-                    "x": bx,
-                    "y": by,
-                    "mask": bvalid.astype(jnp.float32),
-                }
-
-                def loss_wrap(params):
-                    vars_in = _merge_variables(variables, params, {})
-                    loss, (new_state, aux) = trainer.loss_fn(vars_in, batch, srng, True)
-                    if mu > 0.0:
-                        # FedProx proximal term mu/2 * ||w - w_global||^2
-                        # (reference fednova.py:124-126 applies it in-optimizer)
-                        sq = sum(
-                            jnp.sum(jnp.square(p - g))
-                            for p, g in zip(jax.tree.leaves(params), jax.tree.leaves(global_params))
-                        )
-                        loss = loss + 0.5 * mu * sq
-                    return loss, (new_state, aux)
-
-                grad_fn = jax.value_and_grad(loss_wrap, has_aux=True)
-                (_, (new_state, aux)), grads = grad_fn(variables["params"])
-                updates, new_opt_state = opt.update(grads, opt_state, variables["params"])
-                new_params = optax.apply_updates(variables["params"], updates)
-                if full:
-                    # every batch has data: the no-op-step machinery vanishes
-                    variables = _merge_variables(variables, new_params, new_state)
-                    opt_state = new_opt_state
-                    steps = steps + 1
-                    return (variables, opt_state, steps), aux
-                has_data = jnp.any(bvalid)
-                if stateless_opt:
-                    # zero grads already make the update a no-op; only guard
-                    # mutable model state (BN stats) against padded samples
-                    variables = _merge_variables(
-                        variables, new_params,
-                        tree_where(has_data, new_state,
-                                   {k: variables[k] for k in new_state}),
-                    )
-                    opt_state = new_opt_state
-                else:
-                    new_vars = _merge_variables(variables, new_params, new_state)
-                    variables = tree_where(has_data, new_vars, variables)
-                    opt_state = tree_where(has_data, new_opt_state, opt_state)
-                steps = steps + has_data.astype(jnp.int32)
-                return (variables, opt_state, steps), aux
-
-            srngs = jax.random.split(step_rng, nb)
-            (variables, opt_state, steps), auxs = jax.lax.scan(
-                step_body, (variables, opt_state, steps), (xe, ye, batch_valid, srngs)
-            )
-            return (variables, opt_state, steps), auxs
+            return epoch_fn(global_params, carry, x, y, count, erng)
 
         erngs = jax.random.split(rng, cfg.epochs)
         # steps starts as count*0 rather than a literal 0 so that under
@@ -315,6 +336,85 @@ def build_round_fn_from_update(batched_update, aggregator) -> Callable:
 def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
     """Jitted synchronous round: vmap(local_update) + aggregate."""
     return build_round_fn_from_update(_vmapped_update(trainer, cfg), aggregator)
+
+
+def build_chunked_round_runner(trainer, cfg: FedConfig, aggregator,
+                               epoch_chunk: int) -> Callable:
+    """An E-epoch local round as ceil(E/epoch_chunk) host dispatches of
+    epoch_chunk-epoch jitted programs, with the per-client
+    (variables, opt_state, steps) carry DONATED between dispatches.
+
+    Why: a fused E=20 scan is one long device program — it blows past
+    single-dispatch watchdogs (the reference cross-silo configs run E=20,
+    benchmark/README.md:103-112, and BENCH_r05 could only extrapolate).
+    Chunking keeps each dispatch short; `donate_argnums` makes XLA reuse the
+    carry's HBM buffers in place, so the split costs zero device copies —
+    only K-1 extra dispatch latencies (~100s of us against multi-second
+    chunks).
+
+    Numerics: identical trajectory to build_round_fn — same per-client rng
+    stream (crngs = split(rng, C); erngs = split(crng, E), consumed
+    chunk-by-chunk), same epoch body (_build_epoch_fn), same aggregation.
+    Pinned by tests/test_chunked_dispatch.py::test_chunked_round_matches_monolithic.
+
+    Compiles at most two chunk programs (full-size chunks plus one remainder
+    when E % epoch_chunk != 0). Single-host execution shape (vmap over
+    clients) — the shard_map path keeps the monolithic scan.
+    """
+    if epoch_chunk < 1:
+        raise ValueError(f"epoch_chunk must be >= 1, got {epoch_chunk}")
+    if cfg.epochs < 1:
+        raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
+    opt = make_local_optimizer(cfg)
+    epoch_fn = _build_epoch_fn(trainer, cfg, opt)
+
+    def _init(global_variables, counts, rng):
+        c = counts.shape[0]
+        crngs = jax.random.split(rng, c)
+        erngs = jax.vmap(lambda r: jax.random.split(r, cfg.epochs))(crngs)
+        stacked = jax.tree.map(
+            lambda l: jnp.broadcast_to(l, (c,) + l.shape), global_variables)
+        opt_state = jax.vmap(opt.init)(stacked["params"])
+        return stacked, opt_state, (counts * 0).astype(jnp.int32), erngs
+
+    def _chunk(stacked, opt_state, steps, global_params, x, y, counts,
+               erngs_chunk):
+        def one_client(variables, opt_st, st, xc, yc, count, erngs):
+            def body(carry, erng):
+                return epoch_fn(global_params, carry, xc, yc, count, erng)
+            (variables, opt_st, st), auxs = jax.lax.scan(
+                body, (variables, opt_st, st), erngs)
+            # summed train metrics of this chunk's final epoch; the host
+            # keeps only the final chunk's, i.e. the final local epoch's
+            return variables, opt_st, st, {k: v[-1].sum()
+                                           for k, v in auxs.items()}
+        return jax.vmap(one_client)(stacked, opt_state, steps, x, y, counts,
+                                    erngs_chunk)
+
+    def _finish(global_variables, agg_state, stacked, steps, metrics,
+                counts, rng):
+        result = LocalResult(stacked, steps, metrics)
+        new_global, agg_state = aggregator(
+            global_variables, result, counts.astype(jnp.float32), rng,
+            agg_state)
+        return new_global, agg_state, {k: v.sum() for k, v in metrics.items()}
+
+    init_fn = jax.jit(_init)
+    chunk_fn = jax.jit(_chunk, donate_argnums=(0, 1, 2))
+    finish_fn = jax.jit(_finish)
+
+    def round_runner(global_variables, agg_state, x, y, counts, rng):
+        stacked, opt_state, steps, erngs = init_fn(global_variables, counts,
+                                                   rng)
+        metrics = None
+        for k0 in range(0, cfg.epochs, epoch_chunk):
+            stacked, opt_state, steps, metrics = chunk_fn(
+                stacked, opt_state, steps, global_variables["params"],
+                x, y, counts, erngs[:, k0:k0 + epoch_chunk])
+        return finish_fn(global_variables, agg_state, stacked, steps,
+                         metrics, counts, rng)
+
+    return round_runner
 
 
 def build_multi_round_fn_from_update(batched_update, cfg: FedConfig,

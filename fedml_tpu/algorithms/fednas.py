@@ -195,9 +195,11 @@ class FedNASAPI(Checkpointable):
         self.dataset = dataset
         self.cfg = cfg
         self.steps, self.multiplier = steps, multiplier
+        _dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else None
         self.network = DARTSNetwork(output_dim=dataset.class_num,
                                     channels=channels, layers=layers,
-                                    steps=steps, multiplier=multiplier)
+                                    steps=steps, multiplier=multiplier,
+                                    dtype=_dt)
         rng = jax.random.PRNGKey(cfg.seed)
         an, ar = init_alphas(jax.random.fold_in(rng, 1), steps=steps)
         example = jnp.asarray(dataset.train.x[:1, 0])

@@ -25,6 +25,19 @@ aggregator works unchanged.
 Reference anchor: the cross-silo benchmark rows (reference
 benchmark/README.md:103-112); the execution path itself has no reference
 counterpart — it is TPU-first scheduling of the same math.
+
+SCOPE — single chip only. The grouped lowering rides `GroupableConv`'s
+custom batching rule, which fires under `jax.vmap`; inside `shard_map`
+the client axis is a mesh axis, not a vmap axis, so the rule never fires
+and there is nothing to group (each device already holds a single silo's
+conv — exactly the "single silo (no vmap)" rung the r4 ladder measured
+SLOWER than vmap-10, docs/cross_silo_ladder.json). bench.py therefore
+gates `BENCH_SILO_THRESHOLD`'s default-on behind `n_chips == 1`, and the
+multi-chip path (`parallel/sharded.py`) composes `shard_map` with the
+standard engine's `build_local_update` instead. The chunked donated-carry
+dispatch (engine.build_chunked_round_runner) is likewise a vmap-engine
+execution shape and disables silo grouping when both are requested
+(bench.py prints the note).
 """
 
 from __future__ import annotations
@@ -80,9 +93,12 @@ def build_silo_local_update(trainer, cfg: FedConfig) -> Callable:
         raise ValueError(f"cfg.epochs must be >= 1, got {cfg.epochs}")
     opt = make_local_optimizer(cfg)
     mu = cfg.fedprox_mu
-    # same criterion as engine.build_local_update: clip is stateless and maps
-    # zero grads to zero, so sgd-without-momentum/wd keeps the no-op property
-    stateless_opt = cfg.client_optimizer == "sgd" and not cfg.momentum and not cfg.wd
+    # same criterion as engine._build_epoch_fn: clip is stateless and maps
+    # zero grads to zero, so sgd-without-momentum/wd keeps the no-op property.
+    # FedProx disqualifies it — the prox term mu*(p - g) is nonzero on
+    # all-padding batches (keep identical to the engine's)
+    stateless_opt = (cfg.client_optimizer == "sgd" and not cfg.momentum
+                     and not cfg.wd and cfg.fedprox_mu == 0.0)
 
     def silo_update(global_variables, x, y, counts, crngs) -> LocalResult:
         s, n_max = x.shape[0], x.shape[1]

@@ -127,14 +127,39 @@ def _looks_like_html(path: str) -> bool:
     return head.startswith(b"<!doctype html") or head.startswith(b"<html")
 
 
-def _gdrive_confirm_token(html_path: str) -> str:
-    """Pull the confirm token out of the interstitial page; 't' (the
-    modern accept-anyway value) when the page carries none."""
+def _gdrive_retry_url(html_path: str, url: str) -> str:
+    """Build the real download URL out of the virus-scan interstitial.
+
+    The modern interstitial is a GET form posting to
+    drive.usercontent.google.com/download with hidden inputs (id, export,
+    confirm, uuid, ...) — reconstruct exactly that request. Legacy pages
+    instead carry a confirm=<token> in a link; fall back to appending it
+    (or the modern accept-anyway value 't') to the original URL."""
     import re
+    from html.parser import HTMLParser
+    from urllib.parse import urlencode
+
+    class _Form(HTMLParser):
+        def __init__(self):
+            super().__init__()
+            self.action = None
+            self.fields = {}
+
+        def handle_starttag(self, tag, attrs):
+            a = dict(attrs)
+            if tag == "form" and self.action is None and a.get("action"):
+                self.action = a["action"]
+            elif tag == "input" and a.get("name") and "value" in a:
+                self.fields[a["name"]] = a["value"] or ""
 
     with open(html_path, "rb") as f:
-        m = re.search(rb"confirm=([0-9A-Za-z_-]+)", f.read())
-    return m.group(1).decode() if m else "t"
+        html = f.read().decode("utf-8", "replace")
+    form = _Form()
+    form.feed(html)
+    if form.action and form.fields:
+        return form.action + "?" + urlencode(form.fields)
+    m = re.search(r"confirm=([0-9A-Za-z_-]+)", html)
+    return url + "&confirm=" + (m.group(1) if m else "t")
 
 
 def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
@@ -169,14 +194,20 @@ def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
                 # virus-scan interstitial page; saving it would record the
                 # HTML's hash and verify would pass on garbage
                 if "docs.google.com" in url:
-                    retry = url + "&confirm=" + _gdrive_confirm_token(tmp)
+                    retry = _gdrive_retry_url(tmp, url)
                     print(f"  Drive interstitial detected — retrying {retry}")
                     urllib.request.urlretrieve(retry, tmp)  # noqa: S310
                 if _looks_like_html(tmp):
                     os.remove(tmp)
+                    hint = (
+                        " The file may be rate-limited or need a signed-in "
+                        "session: open the URL in a browser, download "
+                        f"manually, place the file at {dst}, and re-run "
+                        "fetch (it will trust and hash the local copy)."
+                        if "docs.google.com" in url else "")
                     raise RuntimeError(
                         f"{url} returned an HTML page, not the artifact — "
-                        "refusing to record it in the manifest")
+                        f"refusing to record it in the manifest.{hint}")
             os.replace(tmp, dst)
         manifest[rel] = {"sha256": _sha256(dst), "bytes": os.path.getsize(dst)}
         if unpack == "tar":

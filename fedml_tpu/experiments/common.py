@@ -49,6 +49,8 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--ckpt_dir", type=str, default=None)
     parser.add_argument("--run_dir", type=str, default="./wandb/latest-run/files")
     parser.add_argument("--fedprox_mu", type=float, default=0.0)
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"])
     return parser
 
 
@@ -93,7 +95,7 @@ def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
         seed=args.seed,
         **extra_load,
     )
-    model_kwargs = {}
+    model_kwargs = {"dtype": cfg.dtype}
     if args.dataset in ("shakespeare", "fed_shakespeare"):
         model_kwargs["vocab_size"] = 90
         model_kwargs["per_position"] = args.dataset == "fed_shakespeare"

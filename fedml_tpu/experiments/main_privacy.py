@@ -116,8 +116,10 @@ def main(argv=None):
             archs = build_hetero_archs(args.branch_num)
         else:
             archs = [ArchSpec()] * args.branch_num
+        _dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else None
         trainers = [ClassificationTrainer(
-            AdaptiveCNN(output_dim=ds.class_num, arch=a)) for a in archs]
+            AdaptiveCNN(output_dim=ds.class_num, arch=a, dtype=_dt))
+            for a in archs]
         shared = (tuple(args.shared_blocks) if args.shared_blocks
                   else (("conv1_out", "conv2_out")
                         if args.ensemble_method == "blockavg" else ()))

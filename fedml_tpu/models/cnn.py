@@ -71,29 +71,31 @@ class HAR_CNN(nn.Module):
     quirk); we emit raw logits, the correct formulation."""
 
     output_dim: int = 6
+    dtype: Any = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.relu(nn.Conv(32, (3,), padding="VALID", name="conv1")(x))
-        x = nn.relu(nn.Conv(32, (3,), padding="VALID", name="conv2")(x))
+        x = nn.relu(nn.Conv(32, (3,), padding="VALID", dtype=self.dtype, name="conv1")(x))
+        x = nn.relu(nn.Conv(32, (3,), padding="VALID", dtype=self.dtype, name="conv2")(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.max_pool(x, (2,), strides=(2,))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(100, name="lin3")(x))
+        x = nn.relu(nn.Dense(100, dtype=self.dtype, name="lin3")(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(self.output_dim, name="lin4")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="lin4")(x)
 
 
 class CNNCifar(nn.Module):
     """Small CIFAR CNN (reference cnn.py:243): conv6/16 5x5 + pools, fc 120/84."""
 
     output_dim: int = 10
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.max_pool(nn.relu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x)), (2, 2), strides=(2, 2))
-        x = nn.max_pool(nn.relu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x)), (2, 2), strides=(2, 2))
+        x = nn.max_pool(nn.relu(nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)), (2, 2), strides=(2, 2))
+        x = nn.max_pool(nn.relu(nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)), (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(120, name="fc1")(x))
-        x = nn.relu(nn.Dense(84, name="fc2")(x))
-        return nn.Dense(self.output_dim, name="fc3")(x)
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc3")(x)

@@ -35,23 +35,28 @@ Genotype = namedtuple("Genotype", "normal normal_concat reduce reduce_concat")
 
 
 def _bn(x):
-    """Stateless affine-free batch standardization over (N, H, W)."""
-    mean = x.mean(axis=(0, 1, 2), keepdims=True)
-    var = x.var(axis=(0, 1, 2), keepdims=True)
-    return (x - mean) / jnp.sqrt(var + 1e-5)
+    """Stateless affine-free batch standardization over (N, H, W).
+
+    Statistics are always computed in f32 (bf16 mean/var of large spatial
+    extents loses mantissa); the result is cast back to the compute dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=(0, 1, 2), keepdims=True)
+    var = x32.var(axis=(0, 1, 2), keepdims=True)
+    return ((x32 - mean) / jnp.sqrt(var + 1e-5)).astype(x.dtype)
 
 
 class ReLUConvBN(nn.Module):
     out_ch: int
     kernel: int = 1
     stride: int = 1
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
         x = nn.Conv(self.out_ch, (self.kernel, self.kernel),
                     (self.stride, self.stride), padding=self.kernel // 2,
-                    use_bias=False)(x)
+                    use_bias=False, dtype=self.dtype)(x)
         return _bn(x)
 
 
@@ -60,12 +65,15 @@ class FactorizedReduce(nn.Module):
     (reference operations.py FactorizedReduce)."""
 
     out_ch: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
-        a = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False)(x)
-        b = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False)(x[:, 1:, 1:, :])
+        a = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False,
+                    dtype=self.dtype)(x)
+        b = nn.Conv(self.out_ch // 2, (1, 1), (2, 2), use_bias=False,
+                    dtype=self.dtype)(x[:, 1:, 1:, :])
         return _bn(jnp.concatenate([a, b], axis=-1))
 
 
@@ -75,6 +83,7 @@ class SepConv(nn.Module):
     out_ch: int
     kernel: int
     stride: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x):
@@ -82,13 +91,14 @@ class SepConv(nn.Module):
         pad = self.kernel // 2
         x = nn.relu(x)
         x = nn.Conv(c, (self.kernel, self.kernel), (self.stride, self.stride),
-                    padding=pad, feature_group_count=c, use_bias=False)(x)
-        x = nn.Conv(c, (1, 1), use_bias=False)(x)
+                    padding=pad, feature_group_count=c, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.Conv(c, (1, 1), use_bias=False, dtype=self.dtype)(x)
         x = _bn(x)
         x = nn.relu(x)
         x = nn.Conv(c, (self.kernel, self.kernel), padding=pad,
-                    feature_group_count=c, use_bias=False)(x)
-        x = nn.Conv(self.out_ch, (1, 1), use_bias=False)(x)
+                    feature_group_count=c, use_bias=False, dtype=self.dtype)(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype)(x)
         return _bn(x)
 
 
@@ -99,6 +109,7 @@ class DilConv(nn.Module):
     kernel: int
     stride: int
     dilation: int = 2
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x):
@@ -107,8 +118,8 @@ class DilConv(nn.Module):
         x = nn.relu(x)
         x = nn.Conv(c, (self.kernel, self.kernel), (self.stride, self.stride),
                     padding=pad, kernel_dilation=self.dilation,
-                    feature_group_count=c, use_bias=False)(x)
-        x = nn.Conv(self.out_ch, (1, 1), use_bias=False)(x)
+                    feature_group_count=c, use_bias=False, dtype=self.dtype)(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype)(x)
         return _bn(x)
 
 
@@ -128,6 +139,7 @@ class MixedOp(nn.Module):
     pools get the affine-free BN the reference appends)."""
 
     stride: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, weights):
@@ -144,18 +156,20 @@ class MixedOp(nn.Module):
             elif prim == "avg_pool_3x3":
                 o = _bn(_pool(x, "avg", self.stride))
             elif prim == "skip_connect":
-                o = x if self.stride == 1 else FactorizedReduce(c)(x)
+                o = x if self.stride == 1 else FactorizedReduce(c, dtype=self.dtype)(x)
             elif prim == "sep_conv_3x3":
-                o = SepConv(c, 3, self.stride)(x)
+                o = SepConv(c, 3, self.stride, dtype=self.dtype)(x)
             elif prim == "sep_conv_5x5":
-                o = SepConv(c, 5, self.stride)(x)
+                o = SepConv(c, 5, self.stride, dtype=self.dtype)(x)
             elif prim == "dil_conv_3x3":
-                o = DilConv(c, 3, self.stride, 2)(x)
+                o = DilConv(c, 3, self.stride, 2, dtype=self.dtype)(x)
             elif prim == "dil_conv_5x5":
-                o = DilConv(c, 5, self.stride, 2)(x)
+                o = DilConv(c, 5, self.stride, 2, dtype=self.dtype)(x)
             outs.append(o)
         stacked = jnp.stack(outs)  # [ops, b, h, w, c]
-        return jnp.tensordot(weights, stacked, axes=(0, 0))
+        # keep the mix in the compute dtype: f32 alphas x bf16 stack would
+        # promote the tensordot back to f32 and poison every downstream op
+        return jnp.tensordot(weights.astype(stacked.dtype), stacked, axes=(0, 0))
 
 
 class Cell(nn.Module):
@@ -167,19 +181,21 @@ class Cell(nn.Module):
     reduction_prev: bool
     steps: int = 4
     multiplier: int = 4
+    dtype: object = None
 
     @nn.compact
     def __call__(self, s0, s1, weights):
         if self.reduction_prev:
-            s0 = FactorizedReduce(self.channels)(s0)
+            s0 = FactorizedReduce(self.channels, dtype=self.dtype)(s0)
         else:
-            s0 = ReLUConvBN(self.channels)(s0)
-        s1 = ReLUConvBN(self.channels)(s1)
+            s0 = ReLUConvBN(self.channels, dtype=self.dtype)(s0)
+        s1 = ReLUConvBN(self.channels, dtype=self.dtype)(s1)
         states = [s0, s1]
         offset = 0
         for i in range(self.steps):
             s = sum(
-                MixedOp(stride=2 if self.reduction and j < 2 else 1)(h, weights[offset + j])
+                MixedOp(stride=2 if self.reduction and j < 2 else 1,
+                        dtype=self.dtype)(h, weights[offset + j])
                 for j, h in enumerate(states)
             )
             offset += len(states)
@@ -201,6 +217,7 @@ class DARTSNetwork(nn.Module):
     steps: int = 4
     multiplier: int = 4
     stem_multiplier: int = 3
+    dtype: object = None
 
     @property
     def num_edges(self) -> int:
@@ -219,7 +236,10 @@ class DARTSNetwork(nn.Module):
         wr = (weights_reduce if weights_reduce is not None
               else nn.softmax(alphas_reduce, axis=-1))
         c_curr = self.stem_multiplier * self.channels
-        s = nn.Conv(c_curr, (3, 3), padding=1, use_bias=False, name="stem")(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        s = nn.Conv(c_curr, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, name="stem")(x)
         s0 = s1 = _bn(s)
         c_curr = self.channels
         reduction_prev = False
@@ -232,11 +252,13 @@ class DARTSNetwork(nn.Module):
                 w = w[i]
             s0, s1 = s1, Cell(
                 channels=c_curr, reduction=reduction, reduction_prev=reduction_prev,
-                steps=self.steps, multiplier=self.multiplier, name=f"cell{i}"
+                steps=self.steps, multiplier=self.multiplier, dtype=self.dtype,
+                name=f"cell{i}"
             )(s0, s1, w)
             reduction_prev = reduction
         out = jnp.mean(s1, axis=(1, 2))
-        return nn.Dense(self.output_dim, name="classifier")(out)
+        return nn.Dense(self.output_dim, dtype=self.dtype,
+                        name="classifier")(out)
 
 
 def gumbel_softmax_st(rng, alphas, tau: float = 5.0, num: int | None = None):

@@ -74,10 +74,10 @@ def round_repeats(repeats: int, depth: float) -> int:
     return int(math.ceil(depth * repeats)) if depth else repeats
 
 
-def _bn(train, name):
+def _bn(train, name, dtype=None):
     # reference batch_norm_momentum=0.99, epsilon=1e-3
     return nn.BatchNorm(use_running_average=not train, momentum=0.99,
-                        epsilon=1e-3, name=name)
+                        epsilon=1e-3, dtype=dtype, name=name)
 
 
 class MBConvBlock(nn.Module):
@@ -86,6 +86,7 @@ class MBConvBlock(nn.Module):
     *input* filters (not the expansion width), bias only on the SE convs."""
     args: BlockArgs
     drop_connect_rate: float = 0.0
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -93,23 +94,25 @@ class MBConvBlock(nn.Module):
         inp, oup = a.input_filters, a.input_filters * a.expand_ratio
         out = x
         if a.expand_ratio != 1:
-            out = nn.Conv(oup, (1, 1), use_bias=False, name="expand_conv")(out)
-            out = nn.swish(_bn(train, "bn0")(out))
+            out = nn.Conv(oup, (1, 1), use_bias=False, dtype=self.dtype,
+                          name="expand_conv")(out)
+            out = nn.swish(_bn(train, "bn0", self.dtype)(out))
         out = nn.Conv(oup, (a.kernel, a.kernel), (a.stride, a.stride),
                       padding="SAME", feature_group_count=oup, use_bias=False,
-                      name="depthwise_conv")(out)
-        out = nn.swish(_bn(train, "bn1")(out))
+                      dtype=self.dtype, name="depthwise_conv")(out)
+        out = nn.swish(_bn(train, "bn1", self.dtype)(out))
 
         if 0.0 < a.se_ratio <= 1.0:
             sq = max(1, int(inp * a.se_ratio))
             s = jnp.mean(out, axis=(1, 2), keepdims=True)
-            s = nn.swish(nn.Conv(sq, (1, 1), name="se_reduce")(s))
-            s = nn.Conv(oup, (1, 1), name="se_expand")(s)
-            out = jax.nn.sigmoid(s) * out
+            s = nn.swish(nn.Conv(sq, (1, 1), dtype=self.dtype,
+                                 name="se_reduce")(s))
+            s = nn.Conv(oup, (1, 1), dtype=self.dtype, name="se_expand")(s)
+            out = (jax.nn.sigmoid(s) * out).astype(out.dtype)
 
         out = nn.Conv(a.output_filters, (1, 1), use_bias=False,
-                      name="project_conv")(out)
-        out = _bn(train, "bn2")(out)
+                      dtype=self.dtype, name="project_conv")(out)
+        out = _bn(train, "bn2", self.dtype)(out)
 
         if a.stride == 1 and a.input_filters == a.output_filters:
             if train and self.drop_connect_rate > 0.0:
@@ -130,12 +133,14 @@ class EfficientNet(nn.Module):
     depth_coefficient: float = 1.0
     dropout_rate: float = 0.2
     drop_connect_rate: float = 0.2
+    dtype: object = None
 
     @classmethod
-    def from_name(cls, name: str, output_dim: int = 1000) -> "EfficientNet":
+    def from_name(cls, name: str, output_dim: int = 1000,
+                  dtype: object = None) -> "EfficientNet":
         w, d, _res, drop = SCALING[name]
         return cls(output_dim=output_dim, width_coefficient=w,
-                   depth_coefficient=d, dropout_rate=drop)
+                   depth_coefficient=d, dropout_rate=drop, dtype=dtype)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -151,9 +156,11 @@ class EfficientNet(nn.Module):
                                    num_repeat=reps))
         total = sum(a.num_repeat for a in plan)
 
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         x = nn.Conv(round_filters(32, w), (3, 3), (2, 2), padding="SAME",
-                    use_bias=False, name="conv_stem")(x)
-        x = nn.swish(_bn(train, "bn_stem")(x))
+                    use_bias=False, dtype=self.dtype, name="conv_stem")(x)
+        x = nn.swish(_bn(train, "bn_stem", self.dtype)(x))
 
         idx = 0
         for a in plan:
@@ -165,12 +172,12 @@ class EfficientNet(nn.Module):
                 )
                 rate = self.drop_connect_rate * idx / total
                 x = MBConvBlock(block_args, drop_connect_rate=rate,
-                                name=f"block{idx}")(x, train)
+                                dtype=self.dtype, name=f"block{idx}")(x, train)
                 idx += 1
 
         x = nn.Conv(round_filters(1280, w), (1, 1), use_bias=False,
-                    name="conv_head")(x)
-        x = nn.swish(_bn(train, "bn_head")(x))
+                    dtype=self.dtype, name="conv_head")(x)
+        x = nn.swish(_bn(train, "bn_head", self.dtype)(x))
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        return nn.Dense(self.output_dim, name="fc")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="fc")(x)

@@ -56,20 +56,25 @@ class AdaptiveCNN(nn.Module):
 
     output_dim: int = 10
     arch: ArchSpec = field(default_factory=ArchSpec)
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for i, w in enumerate(self.arch.conv1):
-            x = nn.relu(nn.Conv(w, (3, 3), padding=1, name=f"conv1_{i}")(x))
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv1_out")(x))
+            x = nn.relu(nn.Conv(w, (3, 3), padding=1, dtype=self.dtype,
+                                name=f"conv1_{i}")(x))
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv1_out")(x))
         for i, w in enumerate(self.arch.conv2):
-            x = nn.relu(nn.Conv(w, (3, 3), padding=1, name=f"conv2_{i}")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2_out")(x))
+            x = nn.relu(nn.Conv(w, (3, 3), padding=1, dtype=self.dtype,
+                                name=f"conv2_{i}")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv2_out")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
         for i, w in enumerate(self.arch.linear1):
-            x = nn.relu(nn.Dense(w, name=f"linear1_{i}")(x))
-        x = nn.relu(nn.Dense(128, name="linear1_out")(x))
+            x = nn.relu(nn.Dense(w, dtype=self.dtype, name=f"linear1_{i}")(x))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype, name="linear1_out")(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(self.output_dim, name="linear2_out")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="linear2_out")(x)

@@ -19,12 +19,13 @@ import flax.linen as nn
 class LogisticRegression(nn.Module):
     output_dim: int
     flatten: bool = True
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.flatten and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.output_dim, name="linear")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="linear")(x)
 
 
 class DenseMLP(nn.Module):
@@ -34,14 +35,15 @@ class DenseMLP(nn.Module):
 
     output_dim: int
     hidden: Sequence[int] = (1024, 512, 256, 128)
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
         for i, h in enumerate(self.hidden):
-            x = nn.tanh(nn.Dense(h, name=f"fc{i + 1}")(x))
-        return nn.Dense(self.output_dim, name="out")(x)
+            x = nn.tanh(nn.Dense(h, dtype=self.dtype, name=f"fc{i + 1}")(x))
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="out")(x)
 
 
 class ReferenceMLP(nn.Module):
@@ -58,12 +60,13 @@ class ReferenceMLP(nn.Module):
     output_dim: int
     hidden: Sequence[int] = (256,)
     dropout: float = 0.5
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
         for i, h in enumerate(self.hidden):
-            x = nn.relu(nn.Dense(h, name=f"fc{i + 1}")(x))
+            x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"fc{i + 1}")(x))
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.Dense(self.output_dim, name="out")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="out")(x)

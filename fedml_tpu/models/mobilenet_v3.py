@@ -39,13 +39,15 @@ class SqueezeBlock(nn.Module):
     """Squeeze-excite on channel dim (reference SqueezeBlock, :64-82)."""
     channels: int
     divide: int = 4
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x):
         s = jnp.mean(x, axis=(1, 2))  # [N, C]
-        s = nn.relu(nn.Dense(self.channels // self.divide, name="fc1")(s))
-        s = h_sigmoid(nn.Dense(self.channels, name="fc2")(s))
-        return x * s[:, None, None, :]
+        s = nn.relu(nn.Dense(self.channels // self.divide, dtype=self.dtype,
+                             name="fc1")(s))
+        s = h_sigmoid(nn.Dense(self.channels, dtype=self.dtype, name="fc2")(s))
+        return x * s[:, None, None, :].astype(x.dtype)
 
 
 class MobileBlock(nn.Module):
@@ -59,6 +61,7 @@ class MobileBlock(nn.Module):
     nonlinear: str  # "RE" | "HS"
     se: bool
     exp: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -67,19 +70,21 @@ class MobileBlock(nn.Module):
         use_connect = self.stride == 1 and in_ch == self.out_ch
         pad = (self.kernel - 1) // 2
 
-        out = nn.Conv(self.exp, (1, 1), use_bias=False, name="expand")(x)
+        out = nn.Conv(self.exp, (1, 1), use_bias=False, dtype=self.dtype,
+                      name="expand")(x)
         out = act(nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                               name="expand_bn")(out))
+                               dtype=self.dtype, name="expand_bn")(out))
         out = nn.Conv(self.exp, (self.kernel, self.kernel),
                       (self.stride, self.stride), padding=pad,
-                      feature_group_count=self.exp, name="depthwise")(out)
+                      feature_group_count=self.exp, dtype=self.dtype,
+                      name="depthwise")(out)
         out = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                           name="depthwise_bn")(out)
+                           dtype=self.dtype, name="depthwise_bn")(out)
         if self.se:
-            out = SqueezeBlock(self.exp, name="se")(out)
-        out = nn.Conv(self.out_ch, (1, 1), name="project")(out)
+            out = SqueezeBlock(self.exp, dtype=self.dtype, name="se")(out)
+        out = nn.Conv(self.out_ch, (1, 1), dtype=self.dtype, name="project")(out)
         out = act(nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                               name="project_bn")(out))
+                               dtype=self.dtype, name="project_bn")(out))
         return x + out if use_connect else out
 
 
@@ -123,6 +128,7 @@ class MobileNetV3(nn.Module):
     mode: str = "LARGE"  # "LARGE" | "SMALL"
     multiplier: float = 1.0
     dropout_rate: float = 0.0
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -130,22 +136,28 @@ class MobileNetV3(nn.Module):
         plan = _LARGE_PLAN if large else _SMALL_PLAN
         d = lambda v: _make_divisible(v * self.multiplier)
 
-        x = nn.Conv(d(16), (3, 3), (2, 2), padding=1, name="init_conv")(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = nn.Conv(d(16), (3, 3), (2, 2), padding=1, dtype=self.dtype,
+                    name="init_conv")(x)
         x = h_swish(nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                 name="init_bn")(x))
+                                 dtype=self.dtype, name="init_bn")(x))
         for i, (_, out_ch, k, s, nl, se, exp) in enumerate(plan):
-            x = MobileBlock(d(out_ch), k, s, nl, se, d(exp), name=f"block{i}")(x, train)
+            x = MobileBlock(d(out_ch), k, s, nl, se, d(exp), dtype=self.dtype,
+                            name=f"block{i}")(x, train)
 
         c1 = d(960 if large else 576)
-        x = nn.Conv(c1, (1, 1), name="out_conv1")(x)
+        x = nn.Conv(c1, (1, 1), dtype=self.dtype, name="out_conv1")(x)
         if not large:
             # reference SMALL applies SE between conv and BN (:227-233)
-            x = SqueezeBlock(c1, name="out_se")(x)
+            x = SqueezeBlock(c1, dtype=self.dtype, name="out_se")(x)
         x = h_swish(nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                 name="out_bn1")(x))
+                                 dtype=self.dtype, name="out_bn1")(x))
         # global average pool, then the reference's conv-pair classifier
         x = jnp.mean(x, axis=(1, 2), keepdims=True)
-        x = h_swish(nn.Conv(d(1280), (1, 1), name="out_conv2")(x))
+        x = h_swish(nn.Conv(d(1280), (1, 1), dtype=self.dtype,
+                            name="out_conv2")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Conv(self.output_dim, (1, 1), name="classifier")(x)
+        x = nn.Conv(self.output_dim, (1, 1), dtype=self.dtype,
+                    name="classifier")(x)
         return x.reshape(x.shape[0], -1)

@@ -17,7 +17,12 @@ def register_model(name: str):
 
 
 def create_model(model_name: str, output_dim: int, **kwargs):
-    """Build a flax module by reference model name (lr, cnn, resnet56, ...)."""
+    """Build a flax module by reference model name (lr, cnn, resnet56, ...).
+
+    Every registered factory honors ``dtype="bfloat16"``: the module computes
+    in bf16 (MXU-native) with f32 parameters. Enforced registry-wide by
+    tests/test_dtype_registry.py — a new factory that drops the knob fails CI.
+    """
     import fedml_tpu.models.zoo  # noqa: F401  (side-effect registration)
 
     if model_name not in _MODELS:

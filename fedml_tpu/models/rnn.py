@@ -23,16 +23,22 @@ class RNN_OriginalFedAvg(nn.Module):
     embedding_dim: int = 8
     hidden_size: int = 256
     per_position: bool = False
+    # compute dtype for the LSTM cell matmuls + fc (bf16 = MXU-native);
+    # params stay f32, cell state follows the compute dtype
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # x: [b, seq] int tokens
-        h = nn.Embed(self.vocab_size, self.embedding_dim, name="embeddings")(x)
-        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm1")(h)
-        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm2")(h)
+        h = nn.Embed(self.vocab_size, self.embedding_dim, dtype=self.dtype,
+                     name="embeddings")(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                   name="lstm1")(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                   name="lstm2")(h)
         if not self.per_position:
             h = h[:, -1]
-        return nn.Dense(self.vocab_size, name="fc")(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype, name="fc")(h)
 
 
 class RNN_StackOverFlow(nn.Module):
@@ -41,12 +47,15 @@ class RNN_StackOverFlow(nn.Module):
     embedding_size: int = 96
     latent_size: int = 670
     num_layers: int = 1
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         extended = self.vocab_size + 3 + self.num_oov_buckets
-        h = nn.Embed(extended, self.embedding_size, name="word_embeddings")(x)
+        h = nn.Embed(extended, self.embedding_size, dtype=self.dtype,
+                     name="word_embeddings")(x)
         for i in range(self.num_layers):
-            h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size), name=f"lstm{i + 1}")(h)
-        h = nn.Dense(self.embedding_size, name="fc1")(h)
-        return nn.Dense(extended, name="fc2")(h)  # [b, seq, extended_vocab]
+            h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size, dtype=self.dtype),
+                       name=f"lstm{i + 1}")(h)
+        h = nn.Dense(self.embedding_size, dtype=self.dtype, name="fc1")(h)
+        return nn.Dense(extended, dtype=self.dtype, name="fc2")(h)  # [b, seq, extended_vocab]

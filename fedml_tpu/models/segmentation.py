@@ -84,10 +84,10 @@ class DeepLabV3Plus(nn.Module):
     stride 4). Returns per-pixel logits at input resolution [b, h, w, C]."""
     output_dim: int = 21
     width: int = 32
-    # compute dtype for the backbone convs (bf16 = MXU-native; BN math f32
-    # via flax promotion). Unlike the CIFAR ResNets' fc, the per-pixel
-    # classifier head stays f32 — segmentation logits feed per-pixel CE
-    # where bf16 resolution costs accuracy for negligible time.
+    # compute dtype for every conv incl. the 1x1 classifier head and the
+    # bilinear upsample (jax.image.resize lowers to dot_general — an f32
+    # head would drag two full-resolution matmuls off the bf16 path); the
+    # returned logits are cast back to f32 for the per-pixel CE.
     dtype: object = None
 
     @nn.compact
@@ -111,7 +111,11 @@ class DeepLabV3Plus(nn.Module):
         h = _SepConv(8 * w, dilation=2, dtype=dt, name="stage3b")(h, train)
         h = _ASPP(4 * w, dtype=dt, name="aspp")(h, train)
 
-        # decoder: upsample x4, concat reduced low-level features, refine
+        # decoder: upsample x4, concat reduced low-level features, refine.
+        # The bilinear resize lowers to dot_general — cast to the compute
+        # dtype first (the preceding BN re-promoted to f32)
+        if dt is not None:
+            h = h.astype(dt)
         h = _resize(h, low_level.shape[1:3])
         ll = nn.Conv(w, (1, 1), use_bias=False, dtype=dt,
                      name="ll_reduce")(low_level)
@@ -120,21 +124,27 @@ class DeepLabV3Plus(nn.Module):
         h = jnp.concatenate([h, ll.astype(h.dtype)], axis=-1)
         h = _SepConv(4 * w, dtype=dt, name="dec1")(h, train)
         h = _SepConv(4 * w, dtype=dt, name="dec2")(h, train)
-        h = nn.Conv(self.output_dim, (1, 1), name="classifier")(h)
-        return _resize(h, in_hw)  # [b, h, w, classes]
+        h = nn.Conv(self.output_dim, (1, 1), dtype=dt, name="classifier")(h)
+        return _resize(h, in_hw).astype(jnp.float32)  # [b, h, w, classes]
 
 
 class SimpleFCN(nn.Module):
     """Tiny FCN kept for fast CI smoke tests of the segmentation path."""
     output_dim: int = 21
     width: int = 32
+    dtype: object = None  # compute dtype (bf16 = MXU-native); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         w = self.width
-        x = nn.relu(nn.Conv(w, (3, 3), (2, 2), padding=1, name="enc1")(x))
-        x = nn.relu(nn.Conv(2 * w, (3, 3), (2, 2), padding=1, name="enc2")(x))
-        x = nn.relu(nn.Conv(2 * w, (3, 3), padding=1, name="mid")(x))
-        x = nn.relu(nn.ConvTranspose(w, (3, 3), (2, 2), name="dec1")(x))
-        x = nn.ConvTranspose(self.output_dim, (3, 3), (2, 2), name="dec2")(x)
+        x = nn.relu(nn.Conv(w, (3, 3), (2, 2), padding=1, dtype=self.dtype,
+                            name="enc1")(x))
+        x = nn.relu(nn.Conv(2 * w, (3, 3), (2, 2), padding=1, dtype=self.dtype,
+                            name="enc2")(x))
+        x = nn.relu(nn.Conv(2 * w, (3, 3), padding=1, dtype=self.dtype,
+                            name="mid")(x))
+        x = nn.relu(nn.ConvTranspose(w, (3, 3), (2, 2), dtype=self.dtype,
+                                     name="dec1")(x))
+        x = nn.ConvTranspose(self.output_dim, (3, 3), (2, 2), dtype=self.dtype,
+                             name="dec2")(x)
         return x  # [b, h, w, classes]

@@ -23,13 +23,16 @@ class _Block(nn.Module):
     d_model: int
     heads: int
     mlp_ratio: int = 4
+    # compute dtype for qkv/proj/mlp matmuls AND the flash kernel (which
+    # follows q/k/v dtype); params stay f32, LayerNorm math promotes to f32
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         b, t, dm = x.shape
         hd = dm // self.heads
-        h = nn.LayerNorm(name="ln1")(x)
-        qkv = nn.Dense(3 * dm, use_bias=False, name="qkv")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * dm, use_bias=False, dtype=self.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, hd),
                             3, axis=2)  # each [B, T, H, hd]
         # flash kernel wants block-divisible T: pick the largest power-of-two
@@ -37,10 +40,11 @@ class _Block(nn.Module):
         blk = next(bb for bb in (128, 64, 32, 16, 8, 4, 2, 1) if t % bb == 0)
         attn = flash_attention(q, k, v, True, blk, blk)
         attn = attn.reshape(b, t, dm)
-        x = x + nn.Dense(dm, use_bias=False, name="proj")(attn)
-        h = nn.LayerNorm(name="ln2")(x)
-        h = nn.gelu(nn.Dense(self.mlp_ratio * dm, name="mlp_up")(h))
-        return x + nn.Dense(dm, name="mlp_down")(h)
+        x = x + nn.Dense(dm, use_bias=False, dtype=self.dtype, name="proj")(attn)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.gelu(nn.Dense(self.mlp_ratio * dm, dtype=self.dtype,
+                             name="mlp_up")(h))
+        return x + nn.Dense(dm, dtype=self.dtype, name="mlp_down")(h)
 
 
 class TransformerLM(nn.Module):
@@ -49,6 +53,7 @@ class TransformerLM(nn.Module):
     heads: int = 4
     num_layers: int = 2
     max_len: int = 512
+    dtype: object = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -58,11 +63,14 @@ class TransformerLM(nn.Module):
             # past max_len onto the last positional embedding row
             raise ValueError(f"sequence length {t} exceeds max_len "
                              f"{self.max_len}; raise max_len")
-        x = nn.Embed(self.vocab_size, self.d_model, name="tok_emb")(tokens)
-        pos = nn.Embed(self.max_len, self.d_model, name="pos_emb")(
-            jnp.arange(t)[None, :])
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_emb")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                       name="pos_emb")(jnp.arange(t)[None, :])
         x = x + pos
         for i in range(self.num_layers):
-            x = _Block(self.d_model, self.heads, name=f"block{i}")(x, train)
-        x = nn.LayerNorm(name="ln_f")(x)
-        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)
+            x = _Block(self.d_model, self.heads, dtype=self.dtype,
+                       name=f"block{i}")(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                        name="lm_head")(x)

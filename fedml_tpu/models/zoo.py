@@ -21,29 +21,37 @@ def _compute_dtype(kw):
 
 @register_model("lr")
 def _lr(output_dim, **kw):
-    return LogisticRegression(output_dim=output_dim, flatten=kw.get("flatten", True))
+    return LogisticRegression(output_dim=output_dim, flatten=kw.get("flatten", True),
+                              dtype=_compute_dtype(kw))
 
 
 @register_model("mlp")
 def _mlp(output_dim, **kw):
-    return DenseMLP(output_dim=output_dim, hidden=tuple(kw.get("hidden", (1024, 512, 256, 128))))
+    return DenseMLP(output_dim=output_dim,
+                    hidden=tuple(kw.get("hidden", (1024, 512, 256, 128))),
+                    dtype=_compute_dtype(kw))
 
 
 @register_model("purchasemlp")
 def _purchasemlp(output_dim, **kw):
     # reference dense_mlp.py:11 PurchaseMLP(input_dim=600, n_classes=100)
-    return ReferenceMLP(output_dim=output_dim, hidden=(256,))
+    return ReferenceMLP(output_dim=output_dim, hidden=(256,),
+                        dtype=_compute_dtype(kw))
 
 
 @register_model("texasmlp")
 def _texasmlp(output_dim, **kw):
     # reference dense_mlp.py:53 TexasMLP(input_dim=6169, n_classes=100)
-    return ReferenceMLP(output_dim=output_dim, hidden=(1024, 512))
+    return ReferenceMLP(output_dim=output_dim, hidden=(1024, 512),
+                        dtype=_compute_dtype(kw))
 
 
 @register_model("cnn_fedavg")
 def _cnn_fedavg(output_dim, **kw):
-    return CNN_OriginalFedAvg(output_dim=output_dim)
+    import jax.numpy as jnp
+
+    return CNN_OriginalFedAvg(output_dim=output_dim,
+                              dtype=_compute_dtype(kw) or jnp.float32)
 
 
 @register_model("cnn")
@@ -57,12 +65,12 @@ def _cnn(output_dim, **kw):
 
 @register_model("cnn_cifar")
 def _cnn_cifar(output_dim, **kw):
-    return CNNCifar(output_dim=output_dim)
+    return CNNCifar(output_dim=output_dim, dtype=_compute_dtype(kw))
 
 
 @register_model("har_cnn")
 def _har_cnn(output_dim, **kw):
-    return HAR_CNN(output_dim=output_dim)
+    return HAR_CNN(output_dim=output_dim, dtype=_compute_dtype(kw))
 
 
 # CIFAR ResNets (reference resnet.py:218,241 / resnet_cifar.py) ---------------
@@ -92,12 +100,14 @@ def _mobilenet(output_dim, **kw):
 def _rnn(output_dim, **kw):
     # shakespeare next-char model (reference main_fedavg.py "rnn" -> vocab 90)
     return RNN_OriginalFedAvg(vocab_size=kw.get("vocab_size", output_dim),
-                              per_position=kw.get("per_position", False))
+                              per_position=kw.get("per_position", False),
+                              dtype=_compute_dtype(kw))
 
 
 @register_model("rnn_stackoverflow")
 def _rnn_so(output_dim, **kw):
-    return RNN_StackOverFlow(vocab_size=kw.get("vocab_size", 10000))
+    return RNN_StackOverFlow(vocab_size=kw.get("vocab_size", 10000),
+                             dtype=_compute_dtype(kw))
 
 
 @register_model("vgg11")
@@ -124,7 +134,8 @@ def _deeplab(output_dim, **kw):
 def _fcn(output_dim, **kw):
     from fedml_tpu.models.segmentation import SimpleFCN
 
-    return SimpleFCN(output_dim=output_dim, width=kw.get("width", 16))
+    return SimpleFCN(output_dim=output_dim, width=kw.get("width", 16),
+                     dtype=_compute_dtype(kw))
 
 
 @register_model("transformer_nwp")
@@ -137,7 +148,8 @@ def _transformer_nwp(output_dim, **kw):
                          d_model=kw.get("d_model", 128),
                          heads=kw.get("heads", 4),
                          num_layers=kw.get("num_layers", 2),
-                         max_len=kw.get("max_len", 512))
+                         max_len=kw.get("max_len", 512),
+                         dtype=_compute_dtype(kw))
 
 
 @register_model("mobilenet_v3")
@@ -148,7 +160,8 @@ def _mobilenet_v3(output_dim, **kw):
     return MobileNetV3(output_dim=output_dim,
                        mode=kw.get("mode", "LARGE"),
                        multiplier=kw.get("multiplier", 1.0),
-                       dropout_rate=kw.get("dropout_rate", 0.0))
+                       dropout_rate=kw.get("dropout_rate", 0.0),
+                       dtype=_compute_dtype(kw))
 
 
 @register_model("efficientnet")
@@ -157,4 +170,5 @@ def _efficientnet(output_dim, **kw):
     from fedml_tpu.models.efficientnet import EfficientNet
 
     return EfficientNet.from_name(kw.get("variant", "efficientnet-b0"),
-                                  output_dim=output_dim)
+                                  output_dim=output_dim,
+                                  dtype=_compute_dtype(kw))

@@ -56,8 +56,9 @@ class BlockEnsembleAPI:
         self.cfg = cfg
         self.branch_num = branch_num
         self.num_paths = num_paths
-        self.module = AdaptiveCNN(output_dim=dataset.class_num,
-                                  arch=arch or ArchSpec())
+        self.module = AdaptiveCNN(
+            output_dim=dataset.class_num, arch=arch or ArchSpec(),
+            dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else None)
         rng = jax.random.PRNGKey(cfg.seed)
         example = jnp.asarray(dataset.train.x[:1, 0])
         self.branches: list[dict] = [

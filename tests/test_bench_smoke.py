@@ -1,0 +1,53 @@
+"""bench.py smoke test through the chunked donated-carry dispatch path.
+
+Runs the real benchmark entrypoint as a subprocess (the same way the
+driver runs it) at toy scale — BENCH_EPOCHS=4 with BENCH_EPOCH_CHUNK=2
+forces two chunk dispatches per round — and checks the emitted JSON line
+is well-formed and records the chunked configuration. This is the
+cheapest end-to-end guard that the BENCH_EPOCHS=20 measurement recipe
+(docs/PERF.md §cross-silo) still runs: same code path, tiny shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_chunked_dispatch_smoke():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        # the conftest's 8-device virtual mesh must NOT leak into the
+        # subprocess: chunked dispatch is the single-chip execution shape
+        # (n_chips > 1 takes the sharded monolithic path in bench.py)
+        XLA_FLAGS="",
+        BENCH_WORKLOAD="flagship",
+        BENCH_CLIENTS_PER_ROUND="2",
+        BENCH_SAMPLES_PER_CLIENT="16",
+        BENCH_BATCH_SIZE="8",
+        BENCH_EPOCHS="4",
+        BENCH_EPOCH_CHUNK="2",
+        BENCH_SCAN_ROUNDS="1",
+        BENCH_ROUNDS="2",
+        BENCH_REPS="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # last stdout line is the bench JSON (stderr carries any notes)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON emitted:\n{proc.stdout}\n{proc.stderr[-2000:]}"
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "fedavg_femnist_cnn_samples_per_sec_per_chip"
+    assert rec["epochs"] == 4
+    assert rec["epoch_chunk"] == 2
+    assert rec["value"] > 0
+    assert rec["round_time_s"] > 0
+    assert rec["spread"]["reps"] == 1

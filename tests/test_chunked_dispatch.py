@@ -1,0 +1,170 @@
+"""Chunked donated-carry epoch dispatch vs the monolithic round, and the
+FedProx stateless-opt fast-path regression.
+
+The chunked runner (engine.build_chunked_round_runner) splits an E-epoch
+local round into K host dispatches with the (variables, opt_state, steps)
+carry donated between them. It must reproduce the monolithic
+build_round_fn trajectory exactly — same rng stream, same epoch body.
+
+The FedProx tests pin the ADVICE.md fix: plain SGD + fedprox_mu > 0 must
+NOT take the stateless-opt fast path, because the proximal gradient
+mu*(p - w_global) is nonzero on all-padding batches even though the masked
+data loss gives exactly-zero grads.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.aggregators import make_aggregator
+from fedml_tpu.algorithms.engine import (
+    build_chunked_round_runner,
+    build_local_update,
+    build_round_fn,
+)
+from fedml_tpu.algorithms.silo_grouped import build_silo_local_update
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.models.linear import DenseMLP
+
+CLIENTS, N, BS, D, C = 3, 24, 8, 6, 4
+
+
+def _setup(epochs, momentum=0.0, fedprox_mu=0.0, counts=None, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.rand(CLIENTS, N, D).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, C, size=(CLIENTS, N)).astype(np.int32))
+    counts = jnp.asarray(counts if counts is not None
+                         else [N, N - 5, N - 11], jnp.int32)
+    cfg = FedConfig(batch_size=BS, epochs=epochs, lr=0.1,
+                    client_optimizer="sgd", momentum=momentum,
+                    fedprox_mu=fedprox_mu,
+                    client_num_per_round=CLIENTS, shuffle=True)
+    trainer = ClassificationTrainer(DenseMLP(output_dim=C, hidden=(8,)))
+    gv = trainer.init(jax.random.PRNGKey(0), x[0, :1])
+    agg = make_aggregator("fedavg", cfg)
+    return cfg, trainer, gv, agg, x, y, counts
+
+
+def _run_rounds(round_fn, gv, st, x, y, counts, key, n=2):
+    m = None
+    for r in range(n):
+        gv, st, m = round_fn(gv, st, x, y, counts, jax.random.fold_in(key, r))
+    return gv, st, m
+
+
+def _assert_trees_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_chunked_round_matches_monolithic():
+    # E=6, chunk=2 -> 3 equal dispatches; momentum exercises the donated
+    # opt_state carry, ragged counts exercise the padding masks, shuffle
+    # exercises the per-epoch rng stream
+    cfg, trainer, gv, agg, x, y, counts = _setup(epochs=6, momentum=0.9)
+    mono = build_round_fn(trainer, cfg, agg)
+    chunked = build_chunked_round_runner(trainer, cfg, agg, epoch_chunk=2)
+
+    key = jax.random.PRNGKey(3)
+    gv_m, st_m, m_m = _run_rounds(mono, gv, agg.init_state(gv), x, y, counts, key)
+    gv_c, st_c, m_c = _run_rounds(chunked, gv, agg.init_state(gv), x, y, counts, key)
+
+    _assert_trees_equal(gv_m, gv_c)
+    assert m_m.keys() == m_c.keys()
+    for k in m_m:
+        np.testing.assert_allclose(float(m_m[k]), float(m_c[k]), rtol=1e-6)
+
+
+def test_chunked_round_remainder_chunk():
+    # E=5, chunk=2 -> dispatches of 2+2+1: the remainder compiles a second
+    # program; trajectory must still match the fused scan
+    cfg, trainer, gv, agg, x, y, counts = _setup(epochs=5)
+    mono = build_round_fn(trainer, cfg, agg)
+    chunked = build_chunked_round_runner(trainer, cfg, agg, epoch_chunk=2)
+
+    key = jax.random.PRNGKey(11)
+    gv_m, _, _ = _run_rounds(mono, gv, agg.init_state(gv), x, y, counts, key, n=1)
+    gv_c, _, _ = _run_rounds(chunked, gv, agg.init_state(gv), x, y, counts, key, n=1)
+    _assert_trees_equal(gv_m, gv_c)
+
+
+def test_chunked_round_single_chunk_degenerates_to_monolithic():
+    cfg, trainer, gv, agg, x, y, counts = _setup(epochs=3)
+    mono = build_round_fn(trainer, cfg, agg)
+    chunked = build_chunked_round_runner(trainer, cfg, agg, epoch_chunk=3)
+    key = jax.random.PRNGKey(5)
+    gv_m, _, _ = _run_rounds(mono, gv, agg.init_state(gv), x, y, counts, key, n=1)
+    gv_c, _, _ = _run_rounds(chunked, gv, agg.init_state(gv), x, y, counts, key, n=1)
+    _assert_trees_equal(gv_m, gv_c)
+
+
+# --- FedProx stateless-opt regression (ADVICE.md) ---------------------------
+
+
+def _fedprox_padding_args(n_max):
+    # one client, count=2 of n_max rows, bs=2: batch 0 has data, the rest are
+    # all-padding. DenseMLP has no dropout and shuffle=False, so the step
+    # rngs are inert and runs with different nb are comparable.
+    rng = np.random.RandomState(7)
+    x_full = jnp.asarray(rng.rand(8, D).astype(np.float32))
+    y_full = jnp.asarray(rng.randint(0, C, size=(8,)).astype(np.int32))
+    return x_full[:n_max], y_full[:n_max]
+
+
+def test_fedprox_plain_sgd_takes_no_prox_only_steps_on_padding():
+    cfg = FedConfig(batch_size=2, epochs=2, lr=0.2, client_optimizer="sgd",
+                    fedprox_mu=0.5, client_num_per_round=1, shuffle=False)
+    trainer = ClassificationTrainer(DenseMLP(output_dim=C, hidden=(8,)))
+    x2, y2 = _fedprox_padding_args(2)   # exactly the valid rows
+    x8, y8 = _fedprox_padding_args(8)   # + three all-padding batches
+    gv = trainer.init(jax.random.PRNGKey(0), x2[:1])
+    update = build_local_update(trainer, cfg)
+
+    key = jax.random.PRNGKey(1)
+    res_pad = jax.jit(update)(gv, x8, y8, jnp.int32(2), key)
+    res_tight = jax.jit(update)(gv, x2, y2, jnp.int32(2), key)
+
+    # padding batches must be complete no-ops: same params as the run that
+    # never saw them, and no steps counted for them
+    assert int(res_pad.num_steps) == int(res_tight.num_steps) == cfg.epochs
+    _assert_trees_equal(res_pad.variables, res_tight.variables)
+
+
+def test_fedprox_padding_regression_would_catch_prox_only_step():
+    # sanity check on the probe itself: an all-padding batch under FedProx
+    # has a NONZERO proximal gradient once params have left the global point
+    # — i.e. the old `stateless_opt` criterion (without the fedprox_mu == 0
+    # clause) really did take a step here, which is what the test above
+    # guards. Simulate one unmasked prox-only step and confirm it moves.
+    cfg = FedConfig(batch_size=2, epochs=1, lr=0.2, client_optimizer="sgd",
+                    fedprox_mu=0.5, client_num_per_round=1, shuffle=False)
+    trainer = ClassificationTrainer(DenseMLP(output_dim=C, hidden=(8,)))
+    x2, y2 = _fedprox_padding_args(2)
+    gv = trainer.init(jax.random.PRNGKey(0), x2[:1])
+    update = build_local_update(trainer, cfg)
+    moved = jax.jit(update)(gv, x2, y2, jnp.int32(2), jax.random.PRNGKey(1))
+    prox_grads = jax.tree.map(lambda p, g: cfg.fedprox_mu * (p - g),
+                              moved.variables["params"], gv["params"])
+    assert max(float(jnp.abs(l).max())
+               for l in jax.tree.leaves(prox_grads)) > 0.0
+
+
+def test_fedprox_silo_grouped_criterion_matches_engine():
+    # silo path must make the same call: FedProx + plain SGD on a silo whose
+    # tail batches are padding matches a run without the padding rows
+    cfg = FedConfig(batch_size=2, epochs=2, lr=0.2, client_optimizer="sgd",
+                    fedprox_mu=0.5, client_num_per_round=1, shuffle=False)
+    trainer = ClassificationTrainer(DenseMLP(output_dim=C, hidden=(8,)))
+    x2, y2 = _fedprox_padding_args(2)
+    x8, y8 = _fedprox_padding_args(8)
+    gv = trainer.init(jax.random.PRNGKey(0), x2[:1])
+    silo_update = build_silo_local_update(trainer, cfg)
+
+    crngs = jax.random.split(jax.random.PRNGKey(1), 1)
+    res_pad = jax.jit(silo_update)(gv, x8[None], y8[None],
+                                   jnp.asarray([2], jnp.int32), crngs)
+    res_tight = jax.jit(silo_update)(gv, x2[None], y2[None],
+                                     jnp.asarray([2], jnp.int32), crngs)
+    assert int(res_pad.num_steps[0]) == int(res_tight.num_steps[0]) == cfg.epochs
+    _assert_trees_equal(res_pad.variables, res_tight.variables)

@@ -1,5 +1,6 @@
 """Unit tests for core contracts: partitioners, packing, pytree ops."""
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -93,6 +94,26 @@ def test_tree_weighted_mean_flat_equals_per_leaf():
                                    np.asarray(want[k], np.float32),
                                    rtol=2e-3 if k == "h" else 1e-6,
                                    atol=1e-6)
+
+
+def test_tree_weighted_mean_flat_budget_guard():
+    """The [C, P] f32 staging copy must be refused — at trace time, with an
+    actionable message — when it exceeds the byte budget; the jitted round
+    aborts before any device allocation instead of OOMing opaquely."""
+    import pytest
+
+    from fedml_tpu.algorithms.aggregators import tree_weighted_mean_flat
+
+    stacked = {"a": jnp.ones((4, 8, 8), jnp.float32)}  # stages 4*64*4 = 1 KiB
+    w = jnp.ones(4)
+    # over budget: raises, names the shape and the escape hatches
+    with pytest.raises(ValueError, match=r"flat_agg.*\[4, 64\].*flat_agg_budget"):
+        tree_weighted_mean_flat(stacked, w, byte_budget=1000)
+    with pytest.raises(ValueError, match="flat_agg"):
+        jax.jit(tree_weighted_mean_flat, static_argnums=2)(stacked, w, 1000)
+    # at budget: runs
+    out = tree_weighted_mean_flat(stacked, w, byte_budget=1024)
+    np.testing.assert_allclose(out["a"], np.ones((8, 8)), rtol=1e-6)
 
 
 def test_tree_where_selects():

@@ -230,3 +230,57 @@ def test_acquire_fetch_rejects_html_interstitial(tmp_path, monkeypatch):
     shutil.copy(page, dst)
     with pytest.raises(RuntimeError, match="delete it"):
         acquire.fetch("shakespeare", str(data_dir))
+
+
+_MODERN_INTERSTITIAL = b"""<!DOCTYPE html><html><body>
+<form id="download-form"
+      action="https://drive.usercontent.google.com/download" method="get">
+  <input type="hidden" name="id" value="XYZ">
+  <input type="hidden" name="export" value="download">
+  <input type="hidden" name="confirm" value="t">
+  <input type="hidden" name="uuid" value="abc-123">
+  <input type="submit" value="Download anyway">
+</form></body></html>"""
+
+
+def test_gdrive_retry_url_parses_modern_form(tmp_path):
+    """The modern virus-scan page is a GET form to
+    drive.usercontent.google.com with hidden inputs — the retry must
+    reconstruct that exact request, not just tack confirm= on the old URL."""
+    from fedml_tpu.data.acquire import _gdrive_retry_url
+
+    page = tmp_path / "page.html"
+    page.write_bytes(_MODERN_INTERSTITIAL)
+    retry = _gdrive_retry_url(
+        str(page), "https://docs.google.com/uc?export=download&id=XYZ")
+    assert retry.startswith("https://drive.usercontent.google.com/download?")
+    assert "id=XYZ" in retry and "confirm=t" in retry and "uuid=abc-123" in retry
+    # the submit button must not leak into the query string
+    assert "Download" not in retry
+
+
+def test_acquire_fetch_retries_through_modern_interstitial(tmp_path, monkeypatch):
+    """First response is the usercontent form page; the reconstructed retry
+    returns the real artifact — fetch must succeed and bless the real bytes."""
+    from fedml_tpu.data import acquire
+
+    monkeypatch.setitem(
+        acquire.CATALOG, "shakespeare",
+        [("shakespeare/train/data.json",
+          "https://docs.google.com/uc?export=download&id=XYZ", None)])
+    calls = []
+
+    def fake_retrieve(url, dst):
+        calls.append(url)
+        with open(dst, "wb") as f:
+            f.write(_MODERN_INTERSTITIAL if len(calls) == 1
+                    else b'{"users": []}')
+
+    monkeypatch.setattr(acquire.urllib.request, "urlretrieve", fake_retrieve)
+    data_dir = tmp_path / "data"
+    assert acquire.fetch("shakespeare", str(data_dir)) == 0
+    assert len(calls) == 2
+    assert calls[1].startswith("https://drive.usercontent.google.com/download?")
+    got = (data_dir / "shakespeare" / "train" / "data.json").read_bytes()
+    assert got == b'{"users": []}'
+    assert (data_dir / f"shakespeare.{acquire.MANIFEST}").exists()
