@@ -166,15 +166,26 @@ def run_zoo_workload(workload: str):
         return
 
     if workload == "fedseg":
-        # one FedSeg round: DeepLabV3+ (width 32) on pascal-shaped data,
-        # 4 clients — the heaviest per-sample model family in the repo
+        # one FedSeg round: DeepLabV3+ on pascal-shaped data, 4 clients —
+        # the heaviest per-sample model family in the repo. Default rung is
+        # 64px / width-32; the COMPUTE-BOUND rung (VERDICT weak #2: the
+        # default is dispatch-bound, so dtype deltas drown in the ±10%
+        # spread) is BENCH_SEG_IMAGE_SIZE=128 BENCH_SEG_WIDTH=64, where
+        # per-sample FLOPs grow ~16x and the conv dtype actually shows.
         from fedml_tpu.algorithms.fedseg import FedSegAPI
 
-        ds = load_dataset("pascal_voc", client_num_in_total=4)
+        image_size = int(os.environ.get("BENCH_SEG_IMAGE_SIZE", 64))
+        width = int(os.environ.get("BENCH_SEG_WIDTH", 32))
+        seg_cap = int(os.environ.get("BENCH_SEG_CAP", 0))
+        dtype = os.environ.get("BENCH_SEG_DTYPE", "bfloat16")
+        ds = load_dataset("pascal_voc", client_num_in_total=4,
+                          image_size=image_size)
+        if seg_cap:
+            ds = _capped(ds, seg_cap)
         cfg = FedConfig(batch_size=8, epochs=1, lr=0.007,
                         client_num_in_total=4, client_num_per_round=4,
                         comm_round=1, frequency_of_the_test=1000,
-                        dtype="bfloat16")
+                        dtype=dtype, extra={"seg_width": width})
         api = FedSegAPI(ds, cfg)
         api.train_one_round(0)  # compile
         import jax as _jax
@@ -187,7 +198,8 @@ def run_zoo_workload(workload: str):
         samples = int(np.asarray(ds.train.counts).sum())
         _emit("fedseg_round_samples_per_sec_per_chip", samples / dt,
               "samples/s/chip", times, samples, round_time_s=round(dt, 3),
-              image_shape=list(np.asarray(ds.train.x[:1, 0]).shape[1:]))
+              image_shape=list(np.asarray(ds.train.x[:1, 0]).shape[1:]),
+              seg_width=width, dtype=dtype)
         return
 
     if workload == "turboaggregate":

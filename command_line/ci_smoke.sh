@@ -40,6 +40,12 @@ EOF
 
 COMMON="--run_dir $RUN_DIR --data_dir ./data --seed 0"
 
+echo "== graft-lint (fails on any new finding; LINT.json is the machine report)"
+# --fast skips the 29-model dtype sweep, which tier-1 runs per-model in
+# tests/test_dtype_registry.py; everything else (engine/silo/darts jaxprs,
+# donation, retrace, partition coverage, AST sweep) runs here
+python -m fedml_tpu.analysis --fast --json LINT.json
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 

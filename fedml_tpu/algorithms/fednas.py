@@ -40,7 +40,8 @@ def _momentum_buffer(w_opt_state, params):
     """The weight optimizer's momentum buffer (optax.TraceState inside the
     chain), or zeros when none has accumulated yet — the reference's
     try/except moment extraction (architect.py:36-40)."""
-    for s in w_opt_state:
+    # optax state is a static-length tuple — trace-time walk, not a scan
+    for s in w_opt_state:  # graft-lint: disable=traced-loop
         if isinstance(s, optax.TraceState):
             return s.trace
     return jax.tree.map(jnp.zeros_like, params)

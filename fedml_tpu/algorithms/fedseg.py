@@ -177,8 +177,12 @@ class FedSegAPI:
         if model_trainer is None:
             from fedml_tpu.models.registry import create_model
 
+            # extra["seg_width"] scales the encoder width (default 32) —
+            # the compute-bound bench rung (128px / width-64) uses it to
+            # resolve dtype deltas outside dispatch noise (docs/PERF.md)
             module = create_model("deeplab", output_dim=dataset.class_num,
-                                  dtype=config.dtype)
+                                  dtype=config.dtype,
+                                  width=int(config.extra.get("seg_width", 32)))
             model_trainer = SegmentationTrainer(module, loss_type=loss_type)
         self.trainer = model_trainer
         self._inner = FedAvgAPI(dataset, config, model_trainer,

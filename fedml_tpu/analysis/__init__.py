@@ -1,0 +1,56 @@
+"""graft-lint — static analysis for jitted federated rounds.
+
+Two engines over one findings contract (``core.Finding``):
+
+- **jaxpr engine** (`jaxpr_engine`): walks ClosedJaxprs of the repo's jitted
+  callables (round runners, aggregator steps, every registry model's apply)
+  and runs dtype-policy / host-sync / dead-cast rules; `check_donation`
+  verifies declared `donate_argnums` actually lower as buffer aliases;
+  `check_retrace` drives a callable and asserts one compile per shape
+  signature.
+- **AST engine** (`ast_engine`): source-level rules over `fedml_tpu/` and
+  `tools/` — host transfers reachable from jit/scan-traced code, Python
+  loops over traced arrays, and the float(np.asarray(...)) sync idiom.
+
+`targets` names what gets linted (the repo's lintable surface);
+`partition` holds the PartitionSpec rule table and the coverage rule;
+``python -m fedml_tpu.analysis`` runs everything and exits nonzero on
+findings. Rules exist because regressions happened: dtype-policy is r5's
+silent-f32 ResNet (PERF.md, 1.63x recovered), donation is the chunked
+dispatch's zero-copy carry contract, retrace is the one-compile-per-shape
+invariant every bench assumes.
+"""
+
+from fedml_tpu.analysis.core import Finding, Report
+from fedml_tpu.analysis.jaxpr_engine import (
+    check_dead_cast,
+    check_donation,
+    check_dtype_policy,
+    check_host_sync,
+    check_retrace,
+    lint_jaxpr,
+    walk_eqns,
+)
+from fedml_tpu.analysis.ast_engine import lint_source, lint_tree
+from fedml_tpu.analysis.partition import (
+    DEFAULT_PARTITION_RULES,
+    check_partition_coverage,
+    match_partition_rules,
+)
+
+__all__ = [
+    "Finding",
+    "Report",
+    "walk_eqns",
+    "lint_jaxpr",
+    "check_dtype_policy",
+    "check_host_sync",
+    "check_dead_cast",
+    "check_donation",
+    "check_retrace",
+    "lint_source",
+    "lint_tree",
+    "DEFAULT_PARTITION_RULES",
+    "match_partition_rules",
+    "check_partition_coverage",
+]

@@ -1,0 +1,46 @@
+"""CLI: `python -m fedml_tpu.analysis [--json LINT.json] [--fast]`.
+
+Exits 0 when the repo is clean, 1 when any rule fires. `--fast` skips the
+29-model dtype sweep (the per-model coverage is also pinned by
+tests/test_dtype_registry.py, so CI smoke can use --fast without losing
+the gate). Run from anywhere — the repo root is derived from the package
+location.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m fedml_tpu.analysis",
+        description="graft-lint: jaxpr + AST static analysis for the "
+                    "repo's jitted federated rounds")
+    p.add_argument("--json", metavar="PATH", default=None,
+                   help="also write the machine-readable report here "
+                        "(e.g. LINT.json)")
+    p.add_argument("--fast", action="store_true",
+                   help="skip the 29-model dtype sweep (covered by tier-1)")
+    p.add_argument("--no-ast", action="store_true",
+                   help="skip the source-level AST rules")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from fedml_tpu.analysis.targets import run_all
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    report = run_all(repo_root, include_models=not args.fast,
+                     include_ast=not args.no_ast)
+    if args.json:
+        report.write_json(args.json)
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

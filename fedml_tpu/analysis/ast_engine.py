@@ -1,0 +1,279 @@
+"""Source-level lint rules over fedml_tpu/ and tools/.
+
+Traced-root detection: a function is "traced" when it is jit-decorated
+(`@jax.jit`, `@partial(jax.jit, ...)`, `@nn.jit`) or its NAME is passed to
+a tracing combinator (`jax.jit(f)`, `jax.vmap`, `jax.grad`,
+`jax.value_and_grad`, `jax.lax.scan/map/fori_loop/while_loop/cond`,
+`jax.checkpoint`, `shard_map`). Tracedness propagates through the
+intra-module call graph: a helper called (by name) from a traced function
+is traced too. Nested `def`s inherit their enclosing function's
+tracedness.
+
+Rules (all suppressible with `# graft-lint: disable=<rule>` on the line or
+the line above):
+
+- `host-transfer`: `.block_until_ready()`, `jax.device_get`, `.item()`,
+  `np.asarray`/`np.array`/`onp.asarray`, and `float()`/`int()` applied to
+  a parameter of the traced function — each forces a host sync (or a
+  ConcretizationError) inside code that is supposed to stay on device.
+- `traced-loop`: `for _ in <param>` inside a traced function — unrolls at
+  trace time into O(n) HLO and retraces when n changes; use lax.scan.
+- `sync-idiom`: `float(np.asarray(x))` ANYWHERE (traced or not) — a
+  double host transfer; `jax.block_until_ready(x)` (no copy) or a single
+  `jax.device_get` is always what's meant.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from fedml_tpu.analysis.core import Finding, is_suppressed
+
+_TRACING_CALLS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "scan", "map", "fori_loop", "while_loop", "cond", "switch", "shard_map",
+    "custom_vmap", "associated_scan", "associative_scan",
+}
+_NP_ALIASES = {"np", "onp", "numpy"}
+_HOST_ATTR_CALLS = {"block_until_ready", "item"}  # x.block_until_ready(), x.item()
+
+
+def _dotted(node) -> str:
+    """'jax.lax.scan' for an Attribute/Name chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_tracing_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    return bool(name) and name.split(".")[-1] in _TRACING_CALLS
+
+
+def _is_jit_decorator(dec) -> bool:
+    if isinstance(dec, ast.Call):
+        name = _dotted(dec.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail in {"jit", "pmap", "checkpoint", "remat"}:
+            return True
+        if tail == "partial" and dec.args:
+            inner = _dotted(dec.args[0])
+            return bool(inner) and inner.split(".")[-1] in _TRACING_CALLS
+        return False
+    name = _dotted(dec)
+    return bool(name) and name.split(".")[-1] in {"jit", "pmap", "checkpoint",
+                                                  "remat"}
+
+
+class _FnInfo:
+    def __init__(self, node: ast.FunctionDef, parent: Optional["_FnInfo"]):
+        self.node = node
+        self.parent = parent
+        self.traced = any(_is_jit_decorator(d) for d in node.decorator_list)
+        self.calls: Set[str] = set()  # local function names this fn calls
+        self.params: Set[str] = {
+            a.arg for a in (node.args.args + node.args.posonlyargs
+                            + node.args.kwonlyargs)}
+
+
+class _Collector(ast.NodeVisitor):
+    """Pass 1: find every function, its decorators, its local calls, and
+    which names get handed to tracing combinators anywhere in the module."""
+
+    def __init__(self):
+        self.fns: Dict[str, _FnInfo] = {}   # qualified-by-nesting name
+        self.by_name: Dict[str, List[_FnInfo]] = {}
+        self.traced_names: Set[str] = set()
+        self._stack: List[_FnInfo] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        info = _FnInfo(node, self._stack[-1] if self._stack else None)
+        self.fns[node.name + f"@{node.lineno}"] = info
+        self.by_name.setdefault(node.name, []).append(info)
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if self._stack:
+            callee = _dotted(node.func)
+            if callee and "." not in callee:
+                self._stack[-1].calls.add(callee)
+        if _is_tracing_call(node):
+            # every plain-name argument to jit/vmap/scan/... is traced
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    self.traced_names.add(a.id)
+                elif isinstance(a, ast.Call):  # jit(partial(f, ...)) etc.
+                    inner = _dotted(a.func)
+                    if inner and inner.split(".")[-1] == "partial" and a.args:
+                        if isinstance(a.args[0], ast.Name):
+                            self.traced_names.add(a.args[0].id)
+        self.generic_visit(node)
+
+
+def _propagate(col: _Collector) -> None:
+    for name in col.traced_names:
+        for info in col.by_name.get(name, []):
+            info.traced = True
+    # nested defs inherit; call-graph closure over local names
+    changed = True
+    while changed:
+        changed = False
+        for info in col.fns.values():
+            if not info.traced and info.parent is not None and info.parent.traced:
+                info.traced = changed = True
+            if info.traced:
+                for callee in info.calls:
+                    for ci in col.by_name.get(callee, []):
+                        if not ci.traced:
+                            ci.traced = changed = True
+
+
+def _is_np_asarray(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if not name or "." not in name:
+        return False
+    head, tail = name.split(".", 1)
+    return head in _NP_ALIASES and tail in {"asarray", "array"}
+
+
+class _RuleRunner(ast.NodeVisitor):
+    """Pass 2: emit findings inside one traced function body (not into
+    nested defs — they're visited as their own _FnInfo)."""
+
+    def __init__(self, info: _FnInfo, path: str, lines: List[str],
+                 findings: List[Finding]):
+        self.info = info
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+
+    def _emit(self, rule: str, node, msg: str):
+        if not is_suppressed(self.lines, node.lineno, rule):
+            self.findings.append(
+                Finding(rule, f"{self.path}:{node.lineno}", msg))
+
+    def visit_FunctionDef(self, node):
+        if node is not self.info.node:
+            return  # nested def handled by its own runner
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if (isinstance(node.func, ast.Attribute) and tail in _HOST_ATTR_CALLS
+                and not name.startswith("jax.")):
+            self._emit("host-transfer", node,
+                       f".{tail}() in traced code forces a host sync")
+        elif name == "jax.device_get":
+            self._emit("host-transfer", node,
+                       "jax.device_get in traced code forces a host sync")
+        elif _is_np_asarray(node):
+            self._emit("host-transfer", node,
+                       f"{name}() in traced code pulls the array to host "
+                       f"(and breaks the trace)")
+        elif isinstance(node.func, ast.Name) and node.func.id in {"float", "int"}:
+            if node.args and self._mentions_param(node.args[0]):
+                self._emit("host-transfer", node,
+                           f"{node.func.id}() on a traced argument "
+                           f"concretizes it — keep it a 0-d array")
+        self.generic_visit(node)
+
+    def _mentions_param(self, expr) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.info.params:
+                return True
+        return False
+
+    def visit_For(self, node: ast.For):
+        it = node.iter
+        if isinstance(it, ast.Name) and it.id in self.info.params:
+            self._emit("traced-loop", node,
+                       f"Python for-loop over traced argument {it.id!r} "
+                       f"unrolls at trace time — use jax.lax.scan")
+        self.generic_visit(node)
+
+
+class _SyncIdiom(ast.NodeVisitor):
+    """float(np.asarray(x)) anywhere in the module — traced or not."""
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call):
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in {"float", "int"} and node.args):
+            inner = node.args[0]
+            # unwrap trailing .ravel()[0] / indexing around the asarray
+            while True:
+                if isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                elif (isinstance(inner, ast.Call)
+                      and isinstance(inner.func, ast.Attribute)
+                      and not _is_np_asarray(inner)):
+                    inner = inner.func.value
+                else:
+                    break
+            if isinstance(inner, ast.Call) and _is_np_asarray(inner):
+                if not is_suppressed(self.lines, node.lineno, "sync-idiom"):
+                    self.findings.append(Finding(
+                        "sync-idiom", f"{self.path}:{node.lineno}",
+                        "float(np.asarray(...)) double-transfers; use "
+                        "jax.block_until_ready (no copy) or one device_get"))
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str) -> List[Finding]:
+    """Run all AST rules on one module's source text."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("host-transfer", f"{path}:{e.lineno or 0}",
+                        f"unparseable module: {e.msg}", severity="warning")]
+    lines = source.splitlines()
+    col = _Collector()
+    col.visit(tree)
+    _propagate(col)
+    findings: List[Finding] = []
+    for info in col.fns.values():
+        if info.traced:
+            _RuleRunner(info, path, lines, findings).visit(info.node)
+    _SyncIdiom(path, lines, findings).visit(tree)
+    return findings
+
+
+def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    with open(path) as f:
+        src = f.read()
+    return lint_source(src, rel or path)
+
+
+def lint_tree(root: str, subdirs: Optional[List[str]] = None) -> List[Finding]:
+    """Lint every .py under `root` (optionally restricted to `subdirs`),
+    reporting repo-relative paths."""
+    findings: List[Finding] = []
+    tops = subdirs or [""]
+    for top in tops:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in {"__pycache__", ".git", ".pytest_cache"}]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    findings += lint_file(full, os.path.relpath(full, root))
+    return findings

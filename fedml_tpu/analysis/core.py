@@ -1,0 +1,95 @@
+"""Findings contract + report plumbing shared by both lint engines."""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+# Every rule the analyzer knows. Keep in sync with docs/INVENTORY.md's table.
+RULES = {
+    "dtype-policy": "f32 dot_general/conv in a bfloat16-policy program",
+    "donation": "donate_argnums arg did not lower as a donated buffer",
+    "host-sync": "pure/debug/io callback primitive inside a jitted round body",
+    "dead-cast": "A->B->A convert_element_type round-trip",
+    "retrace": "more than one compile per shape signature across a drive",
+    "host-transfer": "host sync (float/np.asarray/device_get/...) in traced code",
+    "traced-loop": "Python for-loop over a traced array",
+    "sync-idiom": "float(np.asarray(...)) double-transfer idiom",
+    "partition-coverage": "param tree leaf matches no PartitionSpec rule",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([\w\-,\s]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    target: str          # "module.fn", "file.py:LINE", "model:resnet56", ...
+    message: str
+    severity: str = "error"
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule {self.rule!r}; known: {sorted(RULES)}")
+
+    def __str__(self):
+        return f"{self.target}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    checked: List[str] = field(default_factory=list)   # targets examined
+
+    def extend(self, findings: List[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def mark(self, target: str) -> None:
+        self.checked.append(target)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "num_findings": len(self.findings),
+            "num_targets": len(self.checked),
+            "findings": [asdict(f) for f in self.findings],
+            "targets": self.checked,
+        }
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+            f.write("\n")
+
+    def summary(self) -> str:
+        lines = [str(f) for f in self.findings]
+        lines.append(
+            f"graft-lint: {len(self.findings)} finding(s) across "
+            f"{len(self.checked)} target(s)")
+        return "\n".join(lines)
+
+
+def suppressed_rules(source_line: str) -> Optional[set]:
+    """Rules disabled by a `# graft-lint: disable=rule1,rule2` comment on
+    this line; None when there is no suppression comment."""
+    m = _SUPPRESS_RE.search(source_line)
+    if not m:
+        return None
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def is_suppressed(source_lines: List[str], lineno: int, rule: str) -> bool:
+    """True if `rule` is suppressed on 1-based `lineno` (same line or the
+    line directly above it)."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(source_lines):
+            rules = suppressed_rules(source_lines[ln - 1])
+            if rules and rule in rules:
+                return True
+    return False

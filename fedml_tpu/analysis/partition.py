@@ -1,0 +1,104 @@
+"""PartitionSpec rule table + coverage rule.
+
+The `match_partition_rules` pattern (regex over a 'path/to/param' string ->
+PartitionSpec, scalars auto-replicated, first match wins, unmatched leaf is
+an error) is how model-parallel shardings stay total as models grow: a new
+layer whose params match no rule fails the lint instead of silently
+defaulting to replicated on a TPU pod.
+
+The default table below covers every flax leaf name the zoo produces
+(kernel / bias / scale / mean / var / embedding, plus opt-state counts).
+It is deliberately coarse — the repo's data-parallel engine never consumes
+these specs today; the table is the *coverage contract* that a future
+tensor-parallel pass starts from (ROADMAP: multi-chip scaling).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as PS
+
+from fedml_tpu.analysis.core import Finding
+
+# (path regex, spec). Specs may be shorter than the leaf rank — trailing
+# dims replicate. First match wins.
+DEFAULT_PARTITION_RULES: List[Tuple[str, PS]] = [
+    (r"embedding$", PS("model", None)),      # embed tables: shard the vocab dim
+    (r"kernel$", PS(None, "model")),         # dense/conv: shard the out-features dim
+    (r"(bias|scale)$", PS()),                # norms + biases replicate
+    (r"(mean|var|count)$", PS()),            # batch_stats / opt-state scalars-ish
+]
+
+
+def _flat_paths(tree):
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        keys = []
+        for p in path:
+            if hasattr(p, "key"):
+                keys.append(str(p.key))
+            elif hasattr(p, "idx"):
+                keys.append(str(p.idx))
+            else:
+                keys.append(str(p))
+        out.append(("/".join(keys), leaf))
+    return out
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, PS]], tree):
+    """Map every leaf to a PartitionSpec. Scalars get PS(); a leaf matching
+    no rule raises ValueError naming its path (the lint-rule form of the
+    same check returns Findings instead — see check_partition_coverage)."""
+
+    def match_one(path, leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return PS()
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                return spec
+        raise ValueError(f"partition rule not found for param: {path}")
+
+    flat = _flat_paths(tree)
+    specs = {path: match_one(path, leaf) for path, leaf in flat}
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(tree),
+        [specs[path] for path, _ in flat])
+
+
+def check_partition_coverage(tree, target: str,
+                             rules: Optional[Sequence[Tuple[str, PS]]] = None,
+                             ) -> List[Finding]:
+    """Lint form of match_partition_rules: one Finding per unmatched
+    non-scalar leaf, plus a rank check (a spec longer than the leaf's rank
+    could never be applied)."""
+    rules = DEFAULT_PARTITION_RULES if rules is None else rules
+    out: List[Finding] = []
+    for path, leaf in _flat_paths(tree):
+        if getattr(leaf, "ndim", 0) == 0:
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                if len(spec) > leaf.ndim:
+                    out.append(Finding(
+                        "partition-coverage", target,
+                        f"{path}: rule {pattern!r} spec {spec} is longer "
+                        f"than the leaf's rank {leaf.ndim}"))
+                break
+        else:
+            out.append(Finding(
+                "partition-coverage", target,
+                f"{path} (shape {tuple(leaf.shape)}) matches no "
+                f"PartitionSpec rule — add one to DEFAULT_PARTITION_RULES"))
+    return out
+
+
+def model_variable_shapes(module, shape, in_dtype=jnp.float32):
+    """abstract variables tree for a flax module (eval_shape — no FLOPs)."""
+    rng = jax.random.PRNGKey(0)
+    return jax.eval_shape(
+        lambda: module.init({"params": rng, "dropout": rng},
+                            jnp.zeros(shape, in_dtype), train=False))

@@ -1,0 +1,278 @@
+"""The repo's lintable surface — what `python -m fedml_tpu.analysis` checks.
+
+One table (MODEL_EXAMPLES, moved here from tests/test_dtype_registry.py so
+the test and the CLI share it) plus builders that trace the repo's actual
+jitted programs: engine round runners, the silo-grouped round, every
+aggregator's round, the chunked runner's donated chunk dispatch, the DARTS
+supernet, and a 3-round retrace drive.
+
+Everything traces abstractly (eval_shape / make_jaxpr on
+ShapeDtypeStructs) except the donation and retrace checks, which need the
+real jit machinery — those use the tiniest model in the registry.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.analysis.core import Finding, Report
+from fedml_tpu.analysis.jaxpr_engine import (
+    check_donation,
+    check_retrace,
+    lint_jaxpr,
+)
+from fedml_tpu.analysis.partition import (
+    check_partition_coverage,
+    model_variable_shapes,
+)
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.models.registry import available_models, create_model
+
+# model name -> (example input shape, input dtype, extra factory kwargs).
+# Every registered model MUST have a row (enforced by tests/test_lint.py and
+# tests/test_dtype_registry.py) — a new factory that drops the dtype knob
+# fails the lint, not a bench three rounds later.
+MODEL_EXAMPLES = {
+    "lr": ((2, 32), jnp.float32, {}),
+    "mlp": ((2, 32), jnp.float32, {}),
+    "purchasemlp": ((2, 600), jnp.float32, {}),
+    "texasmlp": ((2, 6169), jnp.float32, {}),
+    "cnn_fedavg": ((2, 28, 28, 1), jnp.float32, {}),
+    "cnn": ((2, 28, 28, 1), jnp.float32, {}),
+    "cnn_cifar": ((2, 32, 32, 3), jnp.float32, {}),
+    "har_cnn": ((2, 128, 9), jnp.float32, {}),
+    "resnet20": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet32": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet44": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet56": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet56_s2d": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet110": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet18": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet34": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet50": ((2, 32, 32, 3), jnp.float32, {}),
+    "resnet18_gn": ((2, 24, 24, 3), jnp.float32, {}),
+    "mobilenet": ((2, 32, 32, 3), jnp.float32, {}),
+    "mobilenet_v3": ((2, 32, 32, 3), jnp.float32, {"mode": "SMALL"}),
+    "efficientnet": ((2, 32, 32, 3), jnp.float32,
+                     {"variant": "efficientnet-b0"}),
+    "vgg11": ((2, 32, 32, 3), jnp.float32, {}),
+    "vgg16": ((2, 32, 32, 3), jnp.float32, {}),
+    "deeplab": ((2, 32, 32, 3), jnp.float32, {}),
+    "fcn": ((2, 16, 16, 3), jnp.float32, {}),
+    "rnn": ((2, 16), jnp.int32, {"vocab_size": 90}),
+    "rnn_stackoverflow": ((2, 12), jnp.int32, {}),
+    "transformer_nwp": ((2, 16), jnp.int32, {}),
+}
+
+
+def models_missing_examples() -> List[str]:
+    return sorted(set(available_models()) - set(MODEL_EXAMPLES))
+
+
+def forward_jaxpr(module, shape, in_dtype):
+    """Abstract forward trace of a flax module (eval_shape init -> make_jaxpr
+    of apply) — zero FLOPs, works for any registry model."""
+    rng = jax.random.PRNGKey(0)
+    x = jax.ShapeDtypeStruct(shape, in_dtype)
+    var_shapes = jax.eval_shape(
+        lambda: module.init({"params": rng, "dropout": rng},
+                            jnp.zeros(shape, in_dtype), train=False))
+    variables = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
+    return jax.make_jaxpr(
+        lambda v, xx: module.apply(v, xx, train=False))(variables, x).jaxpr
+
+
+def model_jaxpr(name: str, dtype: str = "bfloat16"):
+    shape, in_dtype, kw = MODEL_EXAMPLES[name]
+    module = create_model(name, output_dim=10, dtype=dtype, **kw)
+    return forward_jaxpr(module, shape, in_dtype)
+
+
+def darts_jaxpr():
+    """The DARTS supernet is built directly by FedNASAPI (not via the
+    registry) — its mixed-op tensordot path gets its own target."""
+    from fedml_tpu.models.darts import DARTSNetwork, init_alphas
+
+    net = DARTSNetwork(output_dim=10, channels=4, layers=2,
+                       dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    an, ar = init_alphas(rng)
+    x = jnp.zeros((2, 16, 16, 3))
+    var_shapes = jax.eval_shape(
+        lambda: net.init({"params": rng}, x, an, ar, train=False))
+    variables = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
+    return jax.make_jaxpr(
+        lambda v, xx, a, b: net.apply(v, xx, a, b, train=False))(
+        variables, jax.ShapeDtypeStruct(x.shape, x.dtype), an, ar).jaxpr
+
+
+def _tiny_trainer(model: str, dtype: str, **kw):
+    shape, in_dtype, extra = MODEL_EXAMPLES[model]
+    extra = dict(extra, **kw)
+    module = create_model(model, output_dim=10, dtype=dtype, **extra)
+    return ClassificationTrainer(module), shape, in_dtype
+
+
+def _abstract_round_args(trainer, shape, in_dtype, clients: int = 2,
+                         n_max: int = 4):
+    rng = jax.random.PRNGKey(0)
+    var_shapes = jax.eval_shape(
+        lambda: trainer.init(rng, jnp.zeros(shape, in_dtype)))
+    gv = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
+    x = jax.ShapeDtypeStruct((clients, n_max) + shape[1:], in_dtype)
+    y = jax.ShapeDtypeStruct((clients, n_max), jnp.int32)
+    counts = jax.ShapeDtypeStruct((clients,), jnp.int32)
+    return gv, x, y, counts, rng
+
+
+def round_jaxpr(model: str = "cnn", dtype: str = "bfloat16",
+                aggregator_name: str = "fedavg",
+                silo_threshold: int = 0):
+    """Traced jaxpr of one full engine round (vmap(local_update) +
+    aggregate) — or the silo-grouped round when silo_threshold > 0."""
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+
+    trainer, shape, in_dtype = _tiny_trainer(model, dtype)
+    cfg = FedConfig(model=model, batch_size=2, epochs=1, dtype=dtype)
+    agg = make_aggregator(aggregator_name, cfg)
+    if silo_threshold > 0:
+        from fedml_tpu.algorithms.silo_grouped import (
+            build_silo_round_fn, silo_trainer)
+
+        round_fn = build_silo_round_fn(
+            silo_trainer(trainer, silo_threshold), cfg, agg)
+    else:
+        round_fn = build_round_fn(trainer, cfg, agg)
+    gv, x, y, counts, rng = _abstract_round_args(trainer, shape, in_dtype)
+    agg_state = agg.init_state(gv)
+    return jax.make_jaxpr(round_fn)(gv, agg_state, x, y, counts, rng).jaxpr
+
+
+_POLICY = {"bfloat16": jnp.bfloat16, "float32": None}
+
+# Aggregators all run on f32 params (the mixed-precision contract keeps
+# aggregation full-precision), so their rounds lint without a dtype policy.
+AGGREGATOR_NAMES = ("fedavg", "fedopt", "robust", "fednova")
+
+
+def iter_jaxpr_targets(include_models: bool = True,
+                       ) -> Iterator[Tuple[str, object, Optional[object]]]:
+    """(target name, jaxpr, dtype policy or None) for every pure-jaxpr
+    target. Order: cheap engine targets first, the 29-model sweep last."""
+    yield ("engine.round[cnn,bf16,fedavg]",
+           round_jaxpr("cnn", "bfloat16", "fedavg"), jnp.bfloat16)
+    for agg in AGGREGATOR_NAMES:
+        yield (f"engine.round[lr,f32,{agg}]",
+               round_jaxpr("lr", "float32", agg), None)
+    yield ("silo.round[resnet20,bf16,fedavg]",
+           round_jaxpr("resnet20", "bfloat16", "fedavg", silo_threshold=32),
+           jnp.bfloat16)
+    yield ("darts.supernet[bf16]", darts_jaxpr(), jnp.bfloat16)
+    if include_models:
+        for name in sorted(MODEL_EXAMPLES):
+            if name in available_models():
+                yield (f"model:{name}[bf16]", model_jaxpr(name),
+                       jnp.bfloat16)
+
+
+def check_chunked_donation() -> List[Finding]:
+    """The chunked runner's (variables, opt_state, steps) carry must lower
+    as donated buffers — otherwise every chunk boundary pays a full-carry
+    HBM copy and the 'zero device copies' contract in its docstring lies."""
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_chunked_round_runner
+
+    trainer, shape, in_dtype = _tiny_trainer("lr", "float32")
+    cfg = FedConfig(model="lr", batch_size=2, epochs=2, dtype="float32")
+    runner = build_chunked_round_runner(
+        trainer, cfg, make_aggregator("fedavg", cfg), epoch_chunk=1)
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.zeros(shape, in_dtype))
+    c, n = 2, 4
+    counts = jnp.full((c,), n, jnp.int32)
+    stacked, opt_state, steps, erngs = runner.init_fn(gv, counts, rng)
+    x = jnp.zeros((c, n) + shape[1:], in_dtype)
+    y = jnp.zeros((c, n), jnp.int32)
+    args = (stacked, opt_state, steps, gv["params"], x, y, counts,
+            erngs[:, 0:1])
+    return check_donation(
+        runner.chunk_fn, args, "engine.chunked.chunk_fn[lr]",
+        argnums=runner.chunk_donate_argnums)
+
+
+def check_round_retrace(rounds: int = 3) -> List[Finding]:
+    """Drive 3 same-shape rounds through build_round_fn and assert ONE
+    compile — the compile-once-per-shape contract every bench assumes."""
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+
+    trainer, shape, in_dtype = _tiny_trainer("lr", "float32")
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    round_fn = build_round_fn(trainer, cfg, make_aggregator("fedavg", cfg))
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.zeros(shape, in_dtype))
+    c, n = 2, 4
+    x = np.zeros((c, n) + shape[1:], np.float32)
+    y = np.zeros((c, n), np.int32)
+    counts = np.full((c,), n, np.int32)
+
+    state = {"gv": gv, "agg": ()}
+
+    def make_args(i):
+        # fresh host arrays each round — exactly how the benches feed it;
+        # only the rng VALUE changes, never a shape or dtype
+        return (state["gv"], state["agg"], jnp.asarray(x), jnp.asarray(y),
+                jnp.asarray(counts), jax.random.PRNGKey(i))
+
+    return check_retrace(round_fn, make_args,
+                         "engine.round[lr,f32,fedavg]", rounds=rounds)
+
+
+def check_model_partitions() -> List[Finding]:
+    """Every registry model's full variables tree must match a
+    PartitionSpec rule (the match_partition_rules coverage contract)."""
+    out: List[Finding] = []
+    for name in sorted(MODEL_EXAMPLES):
+        if name not in available_models():
+            continue
+        shape, in_dtype, kw = MODEL_EXAMPLES[name]
+        module = create_model(name, output_dim=10, **kw)
+        tree = model_variable_shapes(module, shape, in_dtype)
+        out += check_partition_coverage(tree, f"model:{name}")
+    return out
+
+
+def run_all(repo_root: str, include_models: bool = True,
+            include_ast: bool = True) -> Report:
+    """The full lint pass the CLI and tests/test_lint.py run."""
+    from fedml_tpu.analysis.ast_engine import lint_tree
+
+    report = Report()
+    missing = models_missing_examples()
+    for m in missing:
+        report.extend([Finding(
+            "dtype-policy", f"model:{m}",
+            "registered without a MODEL_EXAMPLES row — the dtype sweep "
+            "cannot see it; add one in fedml_tpu/analysis/targets.py")])
+    for target, jaxpr, policy in iter_jaxpr_targets(include_models):
+        report.extend(lint_jaxpr(jaxpr, target, policy=policy))
+        report.mark(target)
+    report.extend(check_chunked_donation())
+    report.mark("engine.chunked.chunk_fn[lr]")
+    report.extend(check_round_retrace())
+    report.mark("engine.round.retrace[lr]")
+    report.extend(check_model_partitions())
+    report.mark("partition-coverage[registry]")
+    if include_ast:
+        report.extend(lint_tree(repo_root, ["fedml_tpu", "tools"]))
+        report.mark("ast[fedml_tpu,tools]")
+    return report

@@ -100,8 +100,14 @@ class ClassificationTrainer(ModelTrainer):
         mask = batch["mask"].astype(per.dtype)
         denom = jnp.maximum(mask.sum(), 1.0)
         loss = (per * mask).sum() / denom
-        correct = ((jnp.argmax(logits, -1) == batch["y"]) * mask).sum()
-        aux = {"loss_sum": (per * mask).sum(), "correct": correct, "total": mask.sum()}
+        # metric sums accumulate in f32 regardless of compute dtype — bf16
+        # sums lose mantissa past a few hundred samples, and the bf16<->f32
+        # hops surface as dead-cast chains in the round jaxpr (graft-lint)
+        per32 = per.astype(jnp.float32)
+        mask32 = batch["mask"].astype(jnp.float32)
+        correct = ((jnp.argmax(logits, -1) == batch["y"]) * mask32).sum()
+        aux = {"loss_sum": (per32 * mask32).sum(), "correct": correct,
+               "total": mask32.sum()}
         return loss, (new_state, aux)
 
     def eval_fn(self, variables, batch):

@@ -119,7 +119,8 @@ def _first_max_masks(slices, pooled):
     values is exact."""
     pooled32 = pooled.astype(jnp.float32)
     masks, taken = [], None
-    for s in slices:
+    # static Python list of window slices — deliberate trace-time unroll
+    for s in slices:  # graft-lint: disable=traced-loop
         eq = s.astype(jnp.float32) == pooled32
         if taken is None:
             masks.append(eq)

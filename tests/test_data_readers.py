@@ -197,3 +197,105 @@ def test_southwest_edge_case_reader(tmp_path):
                                rtol=1e-5)
     # absent dir -> None (callers fall back to the pixel trigger)
     assert load_edge_case_sets(str(tmp_path / "nope")) is None
+
+
+# --------------------------------------------------------------------------
+# Real-format END-TO-END loads (VERDICT "next round" #6): write the actual
+# on-disk format from bytes, load through the real-file reader path (the
+# no_surrogate fixture proves the fallback never fired), then run ONE
+# federated round on the loaded data — format -> packing -> jitted round.
+
+def _one_round(ds, class_num):
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    n = len(np.asarray(ds.train.counts))
+    cfg = FedConfig(batch_size=4, epochs=1, lr=0.05,
+                    client_num_in_total=n, client_num_per_round=n,
+                    comm_round=1)
+    api = FedAvgAPI(ds, cfg, ClassificationTrainer(
+        create_model("lr", output_dim=class_num)))
+    metrics = api.train_one_round(0)
+    loss = float(jnp.asarray(metrics["loss_sum"]))
+    assert np.isfinite(loss) and loss > 0.0
+
+
+def test_femnist_h5_reader_end_to_end(tmp_path, no_surrogate):
+    h5py = pytest.importorskip("h5py")
+    rng = np.random.RandomState(0)
+
+    def write(path, sizes):
+        with h5py.File(path, "w") as f:
+            ex = f.create_group("examples")
+            for w, n in sizes.items():
+                g = ex.create_group(w)
+                g.create_dataset(
+                    "pixels", data=rng.rand(n, 28, 28).astype(np.float32))
+                g.create_dataset(
+                    "label", data=rng.randint(0, 62, n).astype(np.int64))
+
+    # 3 writers, unbalanced — the TFF natural-split shape
+    write(tmp_path / "fed_emnist_train.h5", {"f0": 9, "f1": 6, "f2": 12})
+    write(tmp_path / "fed_emnist_test.h5", {"f0": 3, "f1": 2, "f2": 4})
+    ds = load_dataset("femnist", data_dir=str(tmp_path),
+                      client_num_in_total=3)
+    assert ds.class_num == 62
+    counts = np.asarray(ds.train.counts)
+    assert sorted(counts.tolist()) == [6, 9, 12]
+    assert ds.train.x.shape[2:] == (28, 28, 1)
+    _one_round(ds, 62)
+
+
+def test_cifar10_pickle_reader_end_to_end(tmp_path, no_surrogate):
+    rng = np.random.RandomState(0)
+    base = tmp_path / "cifar-10-batches-py"
+    base.mkdir()
+
+    def write(path, n):
+        with open(path, "wb") as f:
+            pickle.dump({b"data": rng.randint(0, 256, (n, 3072),
+                                              dtype=np.uint8),
+                         b"labels": rng.randint(0, 10, n).tolist()}, f)
+
+    for i in range(1, 6):
+        write(base / f"data_batch_{i}", 4)
+    write(base / "test_batch", 4)
+    ds = load_dataset("cifar10", data_dir=str(tmp_path),
+                      client_num_in_total=2, partition_method="homo", seed=0)
+    assert ds.class_num == 10
+    assert ds.train_global[0].shape == (20, 32, 32, 3)
+    assert ds.test_global[0].shape == (4, 32, 32, 3)
+    _one_round(ds, 10)
+
+
+def test_raw_mnist_leaf_json_end_to_end(tmp_path, no_surrogate):
+    import json
+
+    rng = np.random.RandomState(0)
+    (tmp_path / "train").mkdir()
+    (tmp_path / "test").mkdir()
+
+    def blob(sizes):
+        return {"users": sorted(sizes),
+                "user_data": {u: {
+                    "x": rng.rand(n, 784).astype(np.float32).tolist(),
+                    "y": rng.randint(0, 10, n).tolist()} for u, n in
+                    sizes.items()},
+                "num_samples": [sizes[u] for u in sorted(sizes)]}
+
+    # two shards in train (the LEAF exporter splits across json files)
+    (tmp_path / "train" / "a.json").write_text(
+        json.dumps(blob({"u0": 8, "u1": 5})))
+    (tmp_path / "train" / "b.json").write_text(json.dumps(blob({"u2": 6})))
+    (tmp_path / "test" / "a.json").write_text(
+        json.dumps(blob({"u0": 2, "u1": 2, "u2": 2})))
+    ds = load_dataset("raw_mnist", data_dir=str(tmp_path))
+    assert ds.class_num == 10
+    counts = np.asarray(ds.train.counts)
+    assert sorted(counts.tolist()) == [5, 6, 8]
+    assert ds.train.x.shape[2:] == (28, 28, 1)
+    _one_round(ds, 10)

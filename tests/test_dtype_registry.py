@@ -1,4 +1,4 @@
-"""Registry-wide bf16 compute-dtype enforcement.
+"""Registry-wide bf16 compute-dtype enforcement — on the graft-lint analyzer.
 
 Every registered model must honor `create_model(name, dtype="bfloat16")`:
 the traced forward jaxpr may contain NO f32 dot_general / conv_general_dilated
@@ -7,110 +7,46 @@ regression this test exists to catch (PERF.md: bf16 moved ResNet-56
 7,641 -> 12,464 samples/s/chip). A new factory that drops the dtype knob
 fails here, not in a bench three rounds later.
 
-Deliberate exemption: we do not descend into pallas kernels (flash attention
-accumulates in f32 *inside* the kernel by design — bf16 in/out, f32
-accumulate is the numerically-correct flash formulation); the registry knob
-controls what the kernel is *fed*, which the surrounding qkv/proj dots cover.
+The jaxpr walker, the example table, and the rule itself live in
+fedml_tpu/analysis (shared with `python -m fedml_tpu.analysis`) — this file
+is the per-model parametrization of that rule, so a failure names the model.
+The pallas exemption (flash attention accumulates f32 inside the kernel by
+design) is the walker's, not this file's.
 """
 
 import jax
 import jax.numpy as jnp
 import pytest
 
+from fedml_tpu.analysis.jaxpr_engine import check_dtype_policy
+from fedml_tpu.analysis.targets import (
+    MODEL_EXAMPLES,
+    darts_jaxpr,
+    model_jaxpr,
+    models_missing_examples,
+)
 from fedml_tpu.models.registry import available_models, create_model
-
-# model name -> (example input shape, input dtype, extra factory kwargs)
-_EXAMPLES = {
-    "lr": ((2, 32), jnp.float32, {}),
-    "mlp": ((2, 32), jnp.float32, {}),
-    "purchasemlp": ((2, 600), jnp.float32, {}),
-    "texasmlp": ((2, 6169), jnp.float32, {}),
-    "cnn_fedavg": ((2, 28, 28, 1), jnp.float32, {}),
-    "cnn": ((2, 28, 28, 1), jnp.float32, {}),
-    "cnn_cifar": ((2, 32, 32, 3), jnp.float32, {}),
-    "har_cnn": ((2, 128, 9), jnp.float32, {}),
-    "resnet20": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet32": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet44": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet56": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet56_s2d": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet110": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet18": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet34": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet50": ((2, 32, 32, 3), jnp.float32, {}),
-    "resnet18_gn": ((2, 24, 24, 3), jnp.float32, {}),
-    "mobilenet": ((2, 32, 32, 3), jnp.float32, {}),
-    "mobilenet_v3": ((2, 32, 32, 3), jnp.float32, {"mode": "SMALL"}),
-    "efficientnet": ((2, 32, 32, 3), jnp.float32,
-                     {"variant": "efficientnet-b0"}),
-    "vgg11": ((2, 32, 32, 3), jnp.float32, {}),
-    "vgg16": ((2, 32, 32, 3), jnp.float32, {}),
-    "deeplab": ((2, 32, 32, 3), jnp.float32, {}),
-    "fcn": ((2, 16, 16, 3), jnp.float32, {}),
-    "rnn": ((2, 16), jnp.int32, {"vocab_size": 90}),
-    "rnn_stackoverflow": ((2, 12), jnp.int32, {}),
-    "transformer_nwp": ((2, 16), jnp.int32, {}),
-}
-
-_MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
-
-
-def _walk_eqns(jaxpr):
-    """All eqns, recursing into scan/cond/pjit/... sub-jaxprs — but NOT into
-    pallas kernels (see module docstring)."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        if "pallas" in eqn.primitive.name:
-            continue
-        for v in eqn.params.values():
-            for sub in jax.tree.leaves(v, is_leaf=lambda l: isinstance(
-                    l, (jax.extend.core.Jaxpr, jax.extend.core.ClosedJaxpr))):
-                if isinstance(sub, jax.extend.core.ClosedJaxpr):
-                    yield from _walk_eqns(sub.jaxpr)
-                elif isinstance(sub, jax.extend.core.Jaxpr):
-                    yield from _walk_eqns(sub)
-
-
-def _assert_no_f32_matmul(jaxpr, name):
-    bad = []
-    for eqn in _walk_eqns(jaxpr):
-        if eqn.primitive.name in _MATMUL_PRIMS:
-            dt = eqn.outvars[0].aval.dtype
-            if dt != jnp.bfloat16:
-                bad.append(f"{eqn.primitive.name} -> {dt}")
-    assert not bad, (
-        f"model {name!r} with dtype='bfloat16' still lowers f32 matmuls "
-        f"(MXU half-rate): {bad[:8]}{' ...' if len(bad) > 8 else ''}")
-
-
-def _forward_jaxpr(module, shape, in_dtype):
-    rng = jax.random.PRNGKey(0)
-    x = jax.ShapeDtypeStruct(shape, in_dtype)
-    var_shapes = jax.eval_shape(
-        lambda: module.init({"params": rng, "dropout": rng},
-                            jnp.zeros(shape, in_dtype), train=False))
-    variables = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
-    return jax.make_jaxpr(
-        lambda v, xx: module.apply(v, xx, train=False))(variables, x).jaxpr
 
 
 def test_examples_cover_every_registered_model():
-    # a new registration without an example here must fail loudly — the
-    # whole point is that the NEXT model added can't dodge the dtype knob
-    missing = set(available_models()) - set(_EXAMPLES)
+    # a new registration without an example must fail loudly — the whole
+    # point is that the NEXT model added can't dodge the dtype knob
+    missing = models_missing_examples()
     assert not missing, (
         f"models registered without a dtype-enforcement example: "
-        f"{sorted(missing)} — add them to _EXAMPLES in {__file__}")
+        f"{missing} — add them to MODEL_EXAMPLES in "
+        f"fedml_tpu/analysis/targets.py")
 
 
-@pytest.mark.parametrize("name", sorted(_EXAMPLES))
+@pytest.mark.parametrize("name", sorted(MODEL_EXAMPLES))
 def test_bf16_forward_has_no_f32_matmul(name):
     if name not in available_models():
         pytest.skip(f"{name} not registered")
-    shape, in_dtype, kw = _EXAMPLES[name]
-    module = create_model(name, output_dim=10, dtype="bfloat16", **kw)
-    _assert_no_f32_matmul(_forward_jaxpr(module, shape, in_dtype), name)
+    findings = check_dtype_policy(model_jaxpr(name), name,
+                                  policy=jnp.bfloat16)
+    assert not findings, (
+        f"model {name!r} with dtype='bfloat16' still lowers f32 matmuls "
+        f"(MXU half-rate): " + "; ".join(f.message for f in findings[:8]))
 
 
 def test_bf16_params_stay_f32():
@@ -127,18 +63,6 @@ def test_bf16_params_stay_f32():
 def test_darts_supernet_bf16_mixed_op_path():
     # DARTSNetwork is built directly by FedNASAPI (not via the registry) —
     # enforce the mixed-op tensordot stays bf16 (f32 alphas must not promote)
-    from fedml_tpu.models.darts import DARTSNetwork, init_alphas
-
-    net = DARTSNetwork(output_dim=10, channels=4, layers=2,
-                       dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
-    an, ar = init_alphas(rng)
-    x = jnp.zeros((2, 16, 16, 3))
-    var_shapes = jax.eval_shape(
-        lambda: net.init({"params": rng}, x, an, ar, train=False))
-    variables = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
-    jaxpr = jax.make_jaxpr(
-        lambda v, xx, a, b: net.apply(v, xx, a, b, train=False))(
-        variables, jax.ShapeDtypeStruct(x.shape, x.dtype), an, ar).jaxpr
-    _assert_no_f32_matmul(jaxpr, "darts")
+    findings = check_dtype_policy(darts_jaxpr(), "darts",
+                                  policy=jnp.bfloat16)
+    assert not findings, "; ".join(f.message for f in findings)

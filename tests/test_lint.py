@@ -1,0 +1,270 @@
+"""graft-lint: every rule fires on its deliberately-bad fixture, and the
+repo itself is clean.
+
+The repo-clean assertions are the teeth: they pin the satellite fixes
+(tools/ sync idioms, bf16 metric sums) so a regression reintroducing any
+of them fails tier-1, not a TPU bench. The per-model dtype sweep lives in
+test_dtype_registry.py (same analyzer, parametrized per model)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as PS
+
+from fedml_tpu.analysis import (
+    check_dead_cast,
+    check_donation,
+    check_dtype_policy,
+    check_host_sync,
+    check_partition_coverage,
+    check_retrace,
+    lint_source,
+)
+from fedml_tpu.analysis.core import Finding, Report
+from fedml_tpu.analysis.partition import match_partition_rules
+
+
+# ---------------------------------------------------------------- jaxpr rules
+
+def test_dtype_policy_fires_on_f32_dot_under_bf16_policy():
+    jaxpr = jax.make_jaxpr(
+        lambda a, b: a @ b)(jnp.zeros((2, 3)), jnp.zeros((3, 4))).jaxpr
+    findings = check_dtype_policy(jaxpr, "fixture", policy=jnp.bfloat16)
+    assert findings and findings[0].rule == "dtype-policy"
+    assert "dot_general" in findings[0].message
+
+
+def test_dtype_policy_recurses_into_scan():
+    def f(w, xs):
+        def body(c, x):
+            return c, x @ w
+        return jax.lax.scan(body, 0.0, xs)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((3, 4)), jnp.zeros((5, 2, 3))).jaxpr
+    assert check_dtype_policy(jaxpr, "fixture", policy=jnp.bfloat16)
+
+
+def test_dtype_policy_clean_on_bf16_dot_and_int_dot():
+    bf = jnp.bfloat16
+    jaxpr = jax.make_jaxpr(
+        lambda a, b: a @ b)(jnp.zeros((2, 3), bf), jnp.zeros((3, 4), bf)).jaxpr
+    assert not check_dtype_policy(jaxpr, "fixture", policy=bf)
+    # integer matmuls (turboaggregate field arithmetic) never fire
+    jaxpr = jax.make_jaxpr(lambda a, b: a @ b)(
+        jnp.zeros((2, 3), jnp.int32), jnp.zeros((3, 4), jnp.int32)).jaxpr
+    assert not check_dtype_policy(jaxpr, "fixture", policy=bf)
+
+
+def test_host_sync_fires_on_pure_callback():
+    def f(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((2,))).jaxpr
+    findings = check_host_sync(jaxpr, "fixture")
+    assert findings and findings[0].rule == "host-sync"
+
+
+def test_host_sync_fires_on_debug_callback_inside_scan():
+    def f(xs):
+        def body(c, x):
+            jax.debug.callback(lambda v: None, x)
+            return c, x
+        return jax.lax.scan(body, 0.0, xs)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((3,))).jaxpr
+    assert check_host_sync(jaxpr, "fixture")
+
+
+def test_dead_cast_fires_on_f32_bf16_f32_roundtrip():
+    def f(x):
+        return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,))).jaxpr
+    findings = check_dead_cast(jaxpr, "fixture")
+    assert findings and findings[0].rule == "dead-cast"
+    assert "float32->bfloat16->float32" in findings[0].message
+
+
+def test_dead_cast_spares_multi_use_intermediate():
+    # the bf16 value is ALSO consumed (e.g. stored) — casting back is not dead
+    def f(x):
+        h = x.astype(jnp.bfloat16)
+        return h.astype(jnp.float32) + 1.0, h * 2
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,))).jaxpr
+    assert not check_dead_cast(jaxpr, "fixture")
+
+
+def test_donation_fires_on_dtype_mismatched_donation():
+    # donated f32 buffer can never alias the bf16 output -> donation dropped
+    bad = jax.jit(lambda x: x.astype(jnp.bfloat16), donate_argnums=(0,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        findings = check_donation(bad, (jnp.zeros((8,)),), "fixture",
+                                  argnums=(0,))
+    assert findings and findings[0].rule == "donation"
+
+
+def test_donation_clean_on_real_donation():
+    good = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    assert not check_donation(good, (jnp.zeros((8,)),), "fixture",
+                              argnums=(0,))
+
+
+def test_retrace_fires_on_weak_type_flapping():
+    # alternating python-scalar / array args flips the weak-type signature
+    # -> one compile per call, the classic silent-retrace bug
+    f = jax.jit(lambda x, s: x * s)
+
+    def make_args(i):
+        return (jnp.zeros((4,)), 1.0 if i % 2 == 0 else jnp.float32(1.0))
+
+    findings = check_retrace(f, make_args, "fixture", rounds=3)
+    assert findings and findings[0].rule == "retrace"
+
+
+def test_retrace_clean_on_stable_signature():
+    f = jax.jit(lambda x, s: x * s)
+
+    def make_args(i):
+        return (jnp.zeros((4,)), jnp.float32(i))
+
+    assert not check_retrace(f, make_args, "fixture", rounds=3)
+
+
+# ------------------------------------------------------------------ AST rules
+
+def _findings(src):
+    return lint_source(src, "fixture.py")
+
+
+def test_ast_host_transfer_fires_in_jit_decorated_fn():
+    src = (
+        "import jax, numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x) + np.asarray(x).sum()\n")
+    rules = [f.rule for f in _findings(src)]
+    assert rules.count("host-transfer") == 2
+
+
+def test_ast_host_transfer_fires_via_call_graph():
+    # helper is only traced because a traced fn calls it
+    src = (
+        "import jax\n"
+        "def helper(v):\n"
+        "    return v.item()\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+        "out = jax.jit(f)\n")
+    assert any(f.rule == "host-transfer" for f in _findings(src))
+
+
+def test_ast_host_transfer_fires_on_scanned_fn():
+    src = (
+        "import jax\n"
+        "def body(c, x):\n"
+        "    x.block_until_ready()\n"
+        "    return c, x\n"
+        "def run(xs):\n"
+        "    return jax.lax.scan(body, 0.0, xs)\n")
+    assert any(f.rule == "host-transfer" for f in _findings(src))
+
+
+def test_ast_traced_loop_fires():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(xs):\n"
+        "    t = 0\n"
+        "    for x in xs:\n"
+        "        t = t + x\n"
+        "    return t\n")
+    assert any(f.rule == "traced-loop" for f in _findings(src))
+
+
+def test_ast_sync_idiom_fires_anywhere():
+    src = (
+        "import numpy as np\n"
+        "def timeit(out):\n"
+        "    return float(np.asarray(out).ravel()[0])\n")
+    assert any(f.rule == "sync-idiom" for f in _findings(src))
+
+
+def test_ast_suppression_comment_silences_rule():
+    src = (
+        "import numpy as np\n"
+        "def timeit(out):\n"
+        "    return float(np.asarray(out).ravel()[0])  # graft-lint: disable=sync-idiom\n")
+    assert not _findings(src)
+
+
+def test_ast_untraced_code_is_not_flagged():
+    src = (
+        "import numpy as np\n"
+        "def pure_host(x):\n"
+        "    return float(x) + np.asarray(x).sum()\n")
+    assert not [f for f in _findings(src) if f.rule == "host-transfer"]
+
+
+# ------------------------------------------------------------ partition rules
+
+def test_partition_coverage_fires_on_unmatched_leaf():
+    tree = {"params": {"odd_name": jax.ShapeDtypeStruct((3, 4), jnp.float32)}}
+    findings = check_partition_coverage(tree, "fixture")
+    assert findings and findings[0].rule == "partition-coverage"
+
+
+def test_match_partition_rules_total_on_standard_names():
+    tree = {"params": {"dense": {"kernel": jax.ShapeDtypeStruct((3, 4), jnp.float32),
+                                 "bias": jax.ShapeDtypeStruct((4,), jnp.float32)},
+                       "step": jax.ShapeDtypeStruct((), jnp.int32)}}
+    from fedml_tpu.analysis import DEFAULT_PARTITION_RULES
+    specs = match_partition_rules(DEFAULT_PARTITION_RULES, tree)
+    assert specs["params"]["dense"]["kernel"] == PS(None, "model")
+    assert specs["params"]["step"] == PS()  # scalars auto-replicate
+    with pytest.raises(ValueError, match="partition rule not found"):
+        match_partition_rules(
+            [], {"params": {"kernel": jax.ShapeDtypeStruct((3, 4), jnp.float32)}})
+
+
+# ----------------------------------------------------------------- repo clean
+
+def test_every_registered_model_has_an_example():
+    from fedml_tpu.analysis.targets import models_missing_examples
+    assert models_missing_examples() == []
+
+
+@pytest.mark.slow
+def test_repo_is_clean_full():
+    import os
+    from fedml_tpu.analysis.targets import run_all
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = run_all(root, include_models=True)
+    assert report.ok, "\n" + report.summary()
+
+
+def test_repo_is_clean_fast():
+    # engine/silo/darts jaxprs + donation + retrace + partition coverage +
+    # the AST sweep over fedml_tpu/ and tools/ (pins the satellite fixes);
+    # the 29-model dtype sweep runs per-model in test_dtype_registry.py
+    import os
+    from fedml_tpu.analysis.targets import run_all
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = run_all(root, include_models=False)
+    assert report.ok, "\n" + report.summary()
+
+
+def test_report_json_roundtrip(tmp_path):
+    import json
+    r = Report()
+    r.extend([Finding("dead-cast", "t", "msg")])
+    r.mark("t")
+    p = tmp_path / "LINT.json"
+    r.write_json(str(p))
+    d = json.loads(p.read_text())
+    assert d["ok"] is False and d["num_findings"] == 1
+    assert d["findings"][0]["rule"] == "dead-cast"

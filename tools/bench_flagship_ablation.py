@@ -68,12 +68,12 @@ def _variant_multi_round(trainer, cfg, num_rounds, metrics_on, real_agg, agg):
 
 def _time(fn, args, reps=3):
     gv, st, _ = fn(*args)
-    float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
+    jax.block_until_ready(gv)
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         gv, st, _ = fn(*args)
-        float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
+        jax.block_until_ready(gv)
         best = min(best, time.perf_counter() - t0)
     return best
 

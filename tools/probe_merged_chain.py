@@ -24,13 +24,13 @@ DEPTH = 6
 
 def _time(fn, args, inner=16, reps=3):
     out = fn(*args)
-    float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    jax.block_until_ready(out)
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(inner):
             out = fn(*args)
-        float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        jax.block_until_ready(out)
         best = min(best, (time.perf_counter() - t0) / inner)
     return best
 

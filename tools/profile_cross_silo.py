@@ -50,13 +50,13 @@ def run(path: str, trace_dir: str, rounds_in_trace: int = 2):
     key = jax.random.PRNGKey(1)
 
     gv2, st2, _ = fn(gv, st, x, y, counts, key)  # compile
-    float(np.asarray(jax.tree.leaves(gv2)[0]).ravel()[0])
+    jax.block_until_ready(gv2)
 
     t0 = time.perf_counter()
     with profile_trace(trace_dir):
         for r in range(rounds_in_trace):
             gv2, st2, _ = fn(gv, st, x, y, counts, jax.random.fold_in(key, r))
-        float(np.asarray(jax.tree.leaves(gv2)[0]).ravel()[0])
+        jax.block_until_ready(gv2)
     dt = time.perf_counter() - t0
     print(f"[{path}] traced {rounds_in_trace} rounds in {dt*1e3:.1f} ms wall")
     return rounds_in_trace

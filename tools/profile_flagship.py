@@ -53,14 +53,12 @@ def run_flagship(trace_dir: str, rounds_in_trace: int = 3):
     # warmup/compile
     gv, state, _ = multi(gv, state, x, y, counts, key)
     jax.block_until_ready(gv)
-    float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
 
     t0 = time.perf_counter()
     with profile_trace(trace_dir):
         for r in range(rounds_in_trace):
             gv, state, _ = multi(gv, state, x, y, counts, jax.random.fold_in(key, r))
         jax.block_until_ready(gv)
-        float(np.asarray(jax.tree.leaves(gv)[0]).ravel()[0])
     dt = time.perf_counter() - t0
     n_rounds = rounds_in_trace * scan_rounds
     print(f"traced {n_rounds} rounds in {dt*1e3:.1f} ms wall "

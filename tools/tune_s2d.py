@@ -70,7 +70,7 @@ def run_one(model_name: str, lr: float, ds, test_batches):
         t0 = time.perf_counter()
         gv_c, st_c, _ = multi(gv_c, st_c, x, y, counts,
                               jax.random.fold_in(key, seg))
-        float(np.asarray(jax.tree.leaves(gv_c)[0]).ravel()[0])
+        jax.block_until_ready(gv_c)
         t_train += time.perf_counter() - t0
         m = eval_fn(gv_c, *test_batches)
         acc = float(m["test_correct"]) / max(float(m["test_total"]), 1.0)
@@ -91,7 +91,8 @@ def main():
 
     from fedml_tpu.data.packing import PackedClients
 
-    cap = (int(np.asarray(ds.train.counts).min()) // BS) * BS
+    # host-side data prep: one intended transfer of a tiny counts vector
+    cap = (int(np.asarray(ds.train.counts).min()) // BS) * BS  # graft-lint: disable=sync-idiom
     ds = dataclasses.replace(
         ds, train=PackedClients(np.asarray(ds.train.x[:, :cap]),
                                 np.asarray(ds.train.y[:, :cap]),
