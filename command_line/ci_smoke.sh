@@ -46,6 +46,13 @@ echo "== graft-lint (fails on any new finding; LINT.json is the machine report)"
 # donation, retrace, partition coverage, AST sweep) runs here
 python -m fedml_tpu.analysis --fast --json LINT.json
 
+echo "== graft-lint HLO layer (collective traffic + memory vs COMMS_BUDGET.json)"
+# lowers every parallel round program on the same 8-virtual-device mesh and
+# gates collective count/bytes and peak memory; --fast skips the two
+# single-chip extras (their zero-collective budgets are pinned by
+# tests/test_comms.py); COMMS.json is the machine report next to LINT.json
+python -m fedml_tpu.analysis --comms --fast --json COMMS.json
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 

@@ -55,18 +55,26 @@ log = logging.getLogger(__name__)
 FLAT_AGG_DEFAULT_BUDGET = 2 << 30
 
 
-def tree_weighted_mean_psum(stacked_tree, weights, axis):
-    """tree_weighted_mean where the client axis is split over mesh `axis`:
-    normalize by the psum'd total weight, locally weight-sum the shard's
-    clients, psum the param-sized partials. Outputs are invariant over
-    `axis` in shard_map's VMA typing (machine-checked replication)."""
-    w = weights / jnp.maximum(jax.lax.psum(jnp.sum(weights), axis), 1e-12)
+def tree_weighted_sum_psum(stacked_tree, weights, axis):
+    """Cross-device weighted SUM: locally weight-sum the shard's clients,
+    psum the param-sized partials over mesh `axis`. Callers own the weight
+    normalization — hierarchical.py normalizes ONCE outside its inner-round
+    scan so the total-weight psum is not a loop-carried collective (the
+    collective-in-loop lint). Outputs are invariant over `axis` in
+    shard_map's VMA typing (machine-checked replication)."""
 
-    def avg(leaf):
-        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+    def wsum(leaf):
+        wb = weights.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
         return jax.lax.psum(jnp.sum(leaf * wb, axis=0), axis)
 
-    return jax.tree.map(avg, stacked_tree)
+    return jax.tree.map(wsum, stacked_tree)
+
+
+def tree_weighted_mean_psum(stacked_tree, weights, axis):
+    """tree_weighted_mean where the client axis is split over mesh `axis`:
+    normalize by the psum'd total weight, then the weighted-sum psum above."""
+    w = weights / jnp.maximum(jax.lax.psum(jnp.sum(weights), axis), 1e-12)
+    return tree_weighted_sum_psum(stacked_tree, w, axis)
 
 
 def tree_weighted_mean_flat(stacked_tree, weights, byte_budget=None):

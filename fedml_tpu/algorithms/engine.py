@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import optax
 
 from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.jax_compat import pcast
 from fedml_tpu.utils.pytree import tree_where
 
 
@@ -275,7 +276,7 @@ def build_local_update(trainer, cfg: FedConfig, pvary_axes: tuple = ()) -> Calla
 
     def local_update(global_variables, x, y, count, rng) -> LocalResult:
         if pvary_axes:
-            global_variables = jax.lax.pcast(
+            global_variables = pcast(
                 global_variables, pvary_axes, to="varying")
         global_params = global_variables["params"]
         opt_state = opt.init(global_params)

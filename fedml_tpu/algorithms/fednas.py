@@ -41,7 +41,7 @@ def _momentum_buffer(w_opt_state, params):
     chain), or zeros when none has accumulated yet — the reference's
     try/except moment extraction (architect.py:36-40)."""
     # optax state is a static-length tuple — trace-time walk, not a scan
-    for s in w_opt_state:  # graft-lint: disable=traced-loop
+    for s in w_opt_state:  # graft-lint: disable=traced-loop -- static optax state tuple, trace-time walk
         if isinstance(s, optax.TraceState):
             return s.trace
     return jax.tree.map(jnp.zeros_like, params)

@@ -1,6 +1,6 @@
 """graft-lint — static analysis for jitted federated rounds.
 
-Two engines over one findings contract (``core.Finding``):
+Three engines over one findings contract (``core.Finding``):
 
 - **jaxpr engine** (`jaxpr_engine`): walks ClosedJaxprs of the repo's jitted
   callables (round runners, aggregator steps, every registry model's apply)
@@ -10,7 +10,14 @@ Two engines over one findings contract (``core.Finding``):
   signature.
 - **AST engine** (`ast_engine`): source-level rules over `fedml_tpu/` and
   `tools/` — host transfers reachable from jit/scan-traced code, Python
-  loops over traced arrays, and the float(np.asarray(...)) sync idiom.
+  loops over traced arrays, the float(np.asarray(...)) sync idiom, and
+  reason-less `# graft-lint: disable` comments (`bare-suppression`).
+- **HLO engine** (`hlo_engine` + `comms`): lowers the parallel round
+  programs on a forced 8-virtual-device host mesh and walks the HLO —
+  collective inventory (kind/count/bytes/groups), loop-invariant
+  collectives, partitioner resharding, ppermute coverage, unweighted
+  psum means, axis-name mismatches — gated per program against
+  COMMS_BUDGET.json (``--comms`` on the CLI).
 
 `targets` names what gets linted (the repo's lintable surface);
 `partition` holds the PartitionSpec rule table and the coverage rule;
@@ -32,6 +39,16 @@ from fedml_tpu.analysis.jaxpr_engine import (
     walk_eqns,
 )
 from fedml_tpu.analysis.ast_engine import lint_source, lint_tree
+from fedml_tpu.analysis.hlo_engine import (
+    analyze_program,
+    check_accidental_replication,
+    check_collective_in_loop,
+    check_ppermute_coverage,
+    check_unweighted_psum_mean,
+    collective_inventory,
+    parse_hlo_text,
+    shape_bytes,
+)
 from fedml_tpu.analysis.partition import (
     DEFAULT_PARTITION_RULES,
     check_partition_coverage,
@@ -50,6 +67,14 @@ __all__ = [
     "check_retrace",
     "lint_source",
     "lint_tree",
+    "parse_hlo_text",
+    "shape_bytes",
+    "collective_inventory",
+    "analyze_program",
+    "check_collective_in_loop",
+    "check_accidental_replication",
+    "check_ppermute_coverage",
+    "check_unweighted_psum_mean",
     "DEFAULT_PARTITION_RULES",
     "match_partition_rules",
     "check_partition_coverage",

@@ -1,15 +1,27 @@
-"""CLI: `python -m fedml_tpu.analysis [--json LINT.json] [--fast]`.
+"""CLI: `python -m fedml_tpu.analysis [--json LINT.json] [--fast] [--comms]`.
 
-Exits 0 when the repo is clean, 1 when any rule fires. `--fast` skips the
-29-model dtype sweep (the per-model coverage is also pinned by
-tests/test_dtype_registry.py, so CI smoke can use --fast without losing
-the gate). Run from anywhere — the repo root is derived from the package
-location.
+Exits 0 when the repo is clean, 1 when any rule fires. Two layers share
+the flag surface:
+
+- default: the jaxpr + AST engines over the lintable surface in
+  `targets.py`. `--fast` skips the 29-model dtype sweep (the per-model
+  coverage is also pinned by tests/test_dtype_registry.py, so CI smoke can
+  use --fast without losing the gate).
+- `--comms`: the HLO layer — lower every parallel round program on a
+  forced 8-virtual-device host mesh, inventory its collectives, estimate
+  peak memory, run the HLO rules, and gate against COMMS_BUDGET.json.
+  `--fast` here skips the two single-chip extras; `--target SUBSTR`
+  (repeatable) lowers only matching programs; `--update-budgets` rewrites
+  the budget table from measurement instead of gating. `--json` writes
+  COMMS.json (the comms report) rather than LINT.json.
+
+Run from anywhere — the repo root is derived from the package location.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -17,23 +29,54 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m fedml_tpu.analysis",
-        description="graft-lint: jaxpr + AST static analysis for the "
+        description="graft-lint: jaxpr + AST + HLO static analysis for the "
                     "repo's jitted federated rounds")
     p.add_argument("--json", metavar="PATH", default=None,
                    help="also write the machine-readable report here "
-                        "(e.g. LINT.json)")
+                        "(LINT.json; COMMS.json under --comms)")
     p.add_argument("--fast", action="store_true",
-                   help="skip the 29-model dtype sweep (covered by tier-1)")
+                   help="skip the 29-model dtype sweep (covered by tier-1); "
+                        "under --comms, skip the single-chip extras")
     p.add_argument("--no-ast", action="store_true",
                    help="skip the source-level AST rules")
+    p.add_argument("--comms", action="store_true",
+                   help="run the HLO layer instead: collective-traffic + "
+                        "memory budget analysis of every parallel round")
+    p.add_argument("--target", action="append", metavar="SUBSTR",
+                   help="(--comms) only lower programs whose name contains "
+                        "SUBSTR; repeatable")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="(--comms) rewrite COMMS_BUDGET.json from the "
+                        "measured traffic instead of gating against it")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from fedml_tpu.analysis.targets import run_all
-
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.comms:
+        # must land before jax initializes its backend — run_comms re-checks
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+        from fedml_tpu.analysis.comms import format_comms_table, run_comms
+
+        report, comms = run_comms(
+            repo_root, fast=args.fast, targets=args.target,
+            update_budgets=args.update_budgets)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(comms, f, indent=2)
+                f.write("\n")
+        print(format_comms_table(comms["programs"]))
+        print(report.summary())
+        return 0 if report.ok else 1
+
+    from fedml_tpu.analysis.targets import run_all
+
     report = run_all(repo_root, include_models=not args.fast,
                      include_ast=not args.no_ast)
     if args.json:

@@ -9,8 +9,9 @@ intra-module call graph: a helper called (by name) from a traced function
 is traced too. Nested `def`s inherit their enclosing function's
 tracedness.
 
-Rules (all suppressible with `# graft-lint: disable=<rule>` on the line or
-the line above):
+Rules (all suppressible with `# graft-lint: disable=<rule> -- <reason>` on
+the line or the line above; the reason is mandatory — a bare disable is
+itself the `bare-suppression` finding):
 
 - `host-transfer`: `.block_until_ready()`, `jax.device_get`, `.item()`,
   `np.asarray`/`np.array`/`onp.asarray`, and `float()`/`int()` applied to
@@ -21,6 +22,9 @@ the line above):
 - `sync-idiom`: `float(np.asarray(x))` ANYWHERE (traced or not) — a
   double host transfer; `jax.block_until_ready(x)` (no copy) or a single
   `jax.device_get` is always what's meant.
+- `bare-suppression`: a `# graft-lint: disable=<rule>` comment without a
+  `-- <reason>` tail — every suppression must say WHY the rule is wrong
+  here, or the next reader deletes the comment and reintroduces the bug.
 """
 
 from __future__ import annotations
@@ -29,7 +33,7 @@ import ast
 import os
 from typing import Dict, List, Optional, Set
 
-from fedml_tpu.analysis.core import Finding, is_suppressed
+from fedml_tpu.analysis.core import Finding, is_suppressed, iter_suppressions
 
 _TRACING_CALLS = {
     "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
@@ -253,6 +257,14 @@ def lint_source(source: str, path: str) -> List[Finding]:
         if info.traced:
             _RuleRunner(info, path, lines, findings).visit(info.node)
     _SyncIdiom(path, lines, findings).visit(tree)
+    for lineno, rules, reason in iter_suppressions(source):
+        if reason is None and not is_suppressed(lines, lineno,
+                                                "bare-suppression"):
+            findings.append(Finding(
+                "bare-suppression", f"{path}:{lineno}",
+                f"suppression of {', '.join(sorted(rules))} has no reason — "
+                "write `# graft-lint: disable=<rule> -- <why it is safe "
+                "here>`"))
     return findings
 
 

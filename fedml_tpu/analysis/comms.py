@@ -1,0 +1,353 @@
+"""The HLO-layer lintable surface + the COMMS_BUDGET.json gate.
+
+`PROGRAMS` names the repo's parallel round programs — the eight shard_map
+rounds (sharded.py's round per aggregator, hierarchical.py's two-axis
+round, gossip.py's ring mix, both sequence.py attention variants) plus two
+single-chip extras (the engine round and the chunked chunk_fn) whose budget
+entries pin their collective count at ZERO: a collective ever appearing in
+the single-chip path is itself the regression. `--fast` skips the extras.
+
+Every program lowers on the forced 8-virtual-device host mesh
+(``--xla_force_host_platform_device_count=8``, set by the CLI before
+backend init; tests get it from conftest.py). `run_comms` feeds each
+program through `hlo_engine.analyze_program` and then gates the measured
+(collective_count, collective_bytes, peak_bytes) against the checked-in
+COMMS_BUDGET.json — exact ceilings for count/bytes (they are deterministic
+functions of the traced program), a 1.5x-headroom ceiling for peak memory
+(an XLA scheduling artifact that wobbles across releases). A program with
+no budget entry is itself a `comms-budget` finding: new parallel code must
+declare its traffic, `--update-budgets` writes the entry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.analysis.core import Finding, Report
+from fedml_tpu.analysis.hlo_engine import ProgramComms, analyze_program
+
+BUDGET_FILE = "COMMS_BUDGET.json"
+
+# peak memory is an XLA scheduling artifact — exact pinning would break on
+# every toolchain bump; 1.5x catches the "suddenly materializes the client
+# stack" class of regression while riding out scheduler noise
+PEAK_HEADROOM = 1.5
+
+N_DEV = 8  # the forced host mesh every program lowers on
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(tree))
+
+
+def _lr_trainer():
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    return ClassificationTrainer(
+        create_model("lr", output_dim=10, dtype="float32"))
+
+
+def _abstract_gv(trainer, shape, in_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(0)
+    var_shapes = jax.eval_shape(
+        lambda: trainer.init(rng, jnp.zeros(shape, in_dtype)))
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes), rng
+
+
+def _sharded_round(agg_name: str):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.parallel.sharded import build_sharded_round_fn
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("clients",))
+    trainer = _lr_trainer()
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    agg = make_aggregator(agg_name, cfg)
+    round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
+    gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    c, n = N_DEV, 4  # one client per device
+    args = (gv, agg_state,
+            jax.ShapeDtypeStruct((c, n, 32), jnp.float32),
+            jax.ShapeDtypeStruct((c, n), jnp.int32),
+            jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+    return round_fn, args, _tree_bytes(gv)
+
+
+def _hier_round():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.parallel.hierarchical import (
+        build_sharded_hierarchical_round_fn)
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(2, 4),
+                ("groups", "clients"))
+    trainer = _lr_trainer()
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    round_fn = build_sharded_hierarchical_round_fn(
+        trainer, cfg, mesh, group_comm_round=2)
+    gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+    g, c, n = 2, 4, 4
+    args = (gv,
+            jax.ShapeDtypeStruct((g, c, n, 32), jnp.float32),
+            jax.ShapeDtypeStruct((g, c, n), jnp.int32),
+            jax.ShapeDtypeStruct((g, c), jnp.int32), rng)
+    return round_fn, args, _tree_bytes(gv)
+
+
+def _gossip_mix():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.gossip import build_sharded_mix
+
+    n = N_DEV
+    # ring: self 0.5, each neighbor 0.25 — 3 nonzero shifts (0, 1, n-1),
+    # so 2 ppermutes per pytree leaf
+    W = np.zeros((n, n), np.float32)
+    for i in range(n):
+        W[i, i] = 0.5
+        W[i, (i + 1) % n] = 0.25
+        W[i, (i - 1) % n] = 0.25
+    mesh = Mesh(np.array(jax.devices()[:n]), ("nodes",))
+    mix = build_sharded_mix(W, mesh)
+    stacked = {
+        "w": jax.ShapeDtypeStruct((n, 16, 4), jnp.float32),
+        "b": jax.ShapeDtypeStruct((n, 4), jnp.float32),
+    }
+    return mix, (stacked,), _tree_bytes(stacked)
+
+
+def _ring_attention():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.sequence import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("sp",))
+    fn = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))
+    s = jax.ShapeDtypeStruct((1, 64, 8, 16), jnp.float32)
+    return fn, (s, s, s), None
+
+
+def _ulysses_attention():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.sequence import ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("sp",))
+    fn = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))
+    s = jax.ShapeDtypeStruct((1, 64, 8, 16), jnp.float32)
+    return fn, (s, s, s), None
+
+
+def _engine_round():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_round_fn
+    from fedml_tpu.core.config import FedConfig
+
+    trainer = _lr_trainer()
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    agg = make_aggregator("fedavg", cfg)
+    round_fn = build_round_fn(trainer, cfg, agg)
+    gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    c, n = 2, 4
+    args = (gv, agg_state,
+            jax.ShapeDtypeStruct((c, n, 32), jnp.float32),
+            jax.ShapeDtypeStruct((c, n), jnp.int32),
+            jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+    return round_fn, args, _tree_bytes(gv)
+
+
+def _chunked_chunk_fn():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import build_chunked_round_runner
+    from fedml_tpu.core.config import FedConfig
+
+    trainer = _lr_trainer()
+    cfg = FedConfig(model="lr", batch_size=2, epochs=2, dtype="float32")
+    runner = build_chunked_round_runner(
+        trainer, cfg, make_aggregator("fedavg", cfg), epoch_chunk=1)
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.zeros((2, 32), jnp.float32))
+    c, n = 2, 4
+    counts = jnp.full((c,), n, jnp.int32)
+    stacked, opt_state, steps, erngs = runner.init_fn(gv, counts, rng)
+    x = jnp.zeros((c, n, 32), jnp.float32)
+    y = jnp.zeros((c, n), jnp.int32)
+    args = (stacked, opt_state, steps, gv["params"], x, y, counts,
+            erngs[:, 0:1])
+    return runner.chunk_fn, args, _tree_bytes(gv)
+
+
+# target name -> (builder, num_devices the program spans). The eight
+# parallel round programs of ISSUE record; the two engine extras carry
+# zero-collective budget entries and are skipped by --fast.
+PROGRAMS: Dict[str, Tuple[Callable, int]] = {
+    "sharded.round[lr,f32,fedavg]": (lambda: _sharded_round("fedavg"), N_DEV),
+    "sharded.round[lr,f32,fedopt]": (lambda: _sharded_round("fedopt"), N_DEV),
+    "sharded.round[lr,f32,robust]": (lambda: _sharded_round("robust"), N_DEV),
+    "sharded.round[lr,f32,fednova]": (lambda: _sharded_round("fednova"),
+                                      N_DEV),
+    "hier.round[lr,f32,2x4]": (_hier_round, N_DEV),
+    "gossip.mix[ring8]": (_gossip_mix, N_DEV),
+    "sequence.ring[b1,t64,h8,d16]": (_ring_attention, N_DEV),
+    "sequence.ulysses[b1,t64,h8,d16]": (_ulysses_attention, N_DEV),
+    "engine.round[lr,f32,fedavg]": (_engine_round, 1),
+    "engine.chunked.chunk_fn[lr]": (_chunked_chunk_fn, 1),
+}
+
+EXTRA_PROGRAMS = ("engine.round[lr,f32,fedavg]",
+                  "engine.chunked.chunk_fn[lr]")
+
+_BUDGET_KEYS = ("collective_count", "collective_bytes", "peak_bytes")
+
+
+def load_budgets(repo_root: str) -> Dict[str, Dict[str, int]]:
+    path = os.path.join(repo_root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def make_budgets(programs: Dict[str, ProgramComms],
+                 existing: Optional[Dict] = None) -> Dict[str, Dict]:
+    """Budget entries for measured programs, merged over `existing` so a
+    filtered --update-budgets run does not drop the rest of the table."""
+    out = dict(existing or {})
+    for name, pc in programs.items():
+        entry = {
+            "collective_count": pc.collective_count,
+            "collective_bytes": pc.collective_bytes,
+        }
+        if pc.peak_bytes is not None:
+            entry["peak_bytes"] = int(pc.peak_bytes * PEAK_HEADROOM)
+        out[name] = entry
+    return dict(sorted(out.items()))
+
+
+def check_budgets(programs: Dict[str, ProgramComms],
+                  budgets: Dict[str, Dict]) -> List[Finding]:
+    """Gate measured comms against the checked-in ceilings. The message is
+    the diff a human needs: key, measured, ceiling, overshoot."""
+    findings: List[Finding] = []
+    for name, pc in programs.items():
+        budget = budgets.get(name)
+        if budget is None:
+            findings.append(Finding(
+                "comms-budget", name,
+                f"no {BUDGET_FILE} entry — new parallel programs must "
+                f"declare their collective traffic; run `python -m "
+                f"fedml_tpu.analysis --comms --update-budgets`"))
+            continue
+        measured = {"collective_count": pc.collective_count,
+                    "collective_bytes": pc.collective_bytes,
+                    "peak_bytes": pc.peak_bytes}
+        for key in _BUDGET_KEYS:
+            ceiling = budget.get(key)
+            got = measured[key]
+            if ceiling is None or got is None:
+                continue
+            if got > ceiling:
+                findings.append(Finding(
+                    "comms-budget", name,
+                    f"{key} regressed: measured {got} > budget {ceiling} "
+                    f"(+{got - ceiling}, {got / ceiling:.2f}x) — if the "
+                    f"extra traffic is intended, re-run with "
+                    f"--update-budgets and justify the bump in the PR"))
+    return findings
+
+
+def run_comms(repo_root: str, fast: bool = False,
+              targets: Optional[List[str]] = None,
+              update_budgets: bool = False,
+              compile_programs: bool = True
+              ) -> Tuple[Report, Dict]:
+    """Lower + analyze every selected program, then apply the budget gate
+    (or rewrite it under --update-budgets). Returns (Report, COMMS dict)."""
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev < N_DEV:
+        raise RuntimeError(
+            f"HLO layer needs {N_DEV} devices, found {ndev} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={N_DEV} "
+            f"before jax initializes (the CLI does this itself)")
+
+    report = Report()
+    programs: Dict[str, ProgramComms] = {}
+    for name, (builder, num_devices) in PROGRAMS.items():
+        if fast and name in EXTRA_PROGRAMS:
+            continue
+        if targets and not any(t in name for t in targets):
+            continue
+        fn, args, params_bytes = builder()
+        comms, findings = analyze_program(
+            fn, args, name, num_devices=num_devices,
+            params_bytes=params_bytes, compile=compile_programs)
+        report.extend(findings)
+        report.mark(name)
+        if comms is not None:
+            programs[name] = comms
+
+    if update_budgets:
+        budgets = make_budgets(programs, existing=load_budgets(repo_root))
+        with open(os.path.join(repo_root, BUDGET_FILE), "w") as f:
+            json.dump(budgets, f, indent=2)
+            f.write("\n")
+    else:
+        report.extend(check_budgets(programs, load_budgets(repo_root)))
+
+    comms_dict = {
+        "ok": report.ok,
+        "num_findings": len(report.findings),
+        "programs": {n: pc.to_dict() for n, pc in programs.items()},
+        "findings": [
+            {"rule": f.rule, "target": f.target, "message": f.message,
+             "severity": f.severity} for f in report.findings],
+    }
+    return report, comms_dict
+
+
+def format_comms_table(programs: Dict[str, Dict]) -> str:
+    """Human-readable per-program traffic table for the CLI."""
+    lines = []
+    for name, pc in programs.items():
+        ops = ", ".join(f"{k}x{v}" for k, v in sorted(pc["per_op"].items()))
+        peak = (f"{pc['peak_bytes']}B peak"
+                if pc.get("peak_bytes") is not None else "peak n/a")
+        lines.append(f"  {name}: {pc['collective_count']} collective(s) "
+                     f"[{ops or 'none'}], {pc['collective_bytes']}B on the "
+                     f"wire, {peak}")
+    return "\n".join(lines)

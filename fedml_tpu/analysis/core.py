@@ -18,9 +18,30 @@ RULES = {
     "traced-loop": "Python for-loop over a traced array",
     "sync-idiom": "float(np.asarray(...)) double-transfer idiom",
     "partition-coverage": "param tree leaf matches no PartitionSpec rule",
+    # HLO-layer rules (hlo_engine / comms): lowered-program collectives
+    "collective-in-loop": "loop-invariant collective inside a while/scan body",
+    "accidental-replication": "partitioner all-gather rematerializes the "
+                              "full param tree on every device",
+    "ppermute-coverage": "collective-permute source/target pairs are not a "
+                         "permutation of the full axis group",
+    "unweighted-psum-mean": "psum(x)/axis_size mean where the repo's "
+                            "weighted-mean aggregation was intended",
+    "axis-name-mismatch": "collective names a mesh axis the program's mesh "
+                          "does not bind",
+    "comms-budget": "program exceeds its COMMS_BUDGET.json collective/memory "
+                    "ceiling (or has no budget entry)",
+    "bare-suppression": "graft-lint: disable comment without a '-- reason'",
 }
 
-_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([\w\-,\s]+)")
+# Suppression grammar: `# graft-lint: disable=rule1,rule2 -- reason`.
+# The rule list is comma-separated rule names only; the ` -- ` separator
+# (spaces required) starts the mandatory human reason. The char class
+# deliberately excludes spaces so a reason can never be swallowed into a
+# rule name.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*disable="
+    r"([\w\-]+(?:\s*,\s*[\w\-]+)*)"
+    r"(?:\s+--\s+(\S.*))?")
 
 
 @dataclass
@@ -76,12 +97,32 @@ class Report:
 
 
 def suppressed_rules(source_line: str) -> Optional[set]:
-    """Rules disabled by a `# graft-lint: disable=rule1,rule2` comment on
-    this line; None when there is no suppression comment."""
+    """Rules disabled by a `# graft-lint: disable=rule1,rule2 -- reason`
+    comment on this line; None when there is no suppression comment."""
     m = _SUPPRESS_RE.search(source_line)
     if not m:
         return None
     return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def suppression_reason(source_line: str) -> Optional[str]:
+    """The `-- reason` text of a suppression comment on this line; None when
+    there is no suppression comment OR the suppression is bare (no reason) —
+    callers distinguish the two via suppressed_rules()."""
+    m = _SUPPRESS_RE.search(source_line)
+    if not m:
+        return None
+    return m.group(2)
+
+
+def iter_suppressions(source: str):
+    """(1-based lineno, rules set, reason-or-None) for every graft-lint
+    suppression comment in `source` — the bare-suppression rule's walk."""
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            yield i, rules, m.group(2)
 
 
 def is_suppressed(source_lines: List[str], lineno: int, rule: str) -> bool:

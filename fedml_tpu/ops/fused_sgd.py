@@ -120,7 +120,7 @@ def _first_max_masks(slices, pooled):
     pooled32 = pooled.astype(jnp.float32)
     masks, taken = [], None
     # static Python list of window slices — deliberate trace-time unroll
-    for s in slices:  # graft-lint: disable=traced-loop
+    for s in slices:  # graft-lint: disable=traced-loop -- static window-slice list, intended unroll
         eq = s.astype(jnp.float32) == pooled32
         if taken is None:
             masks.append(eq)

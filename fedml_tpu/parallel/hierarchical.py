@@ -28,9 +28,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from fedml_tpu.algorithms.aggregators import tree_weighted_mean_psum
+from fedml_tpu.algorithms.aggregators import (
+    tree_weighted_mean_psum,
+    tree_weighted_sum_psum,
+)
 from fedml_tpu.algorithms.engine import build_local_update
 from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.jax_compat import pcast, shard_map
 
 
 def build_sharded_hierarchical_round_fn(
@@ -67,7 +71,15 @@ def build_sharded_hierarchical_round_fn(
             # inner-scan carry: starts as the invariant global broadcast,
             # exits varying over the groups axis (each group trains its own
             # line) — pcast so the carry types match under check_vma
-            gv = jax.lax.pcast(gv, (group_axis,), to="varying")
+            gv = pcast(gv, (group_axis,), to="varying")
+            # the group's total client weight is round-invariant, so its
+            # psum is hoisted OUT of the inner-round scan: one scalar
+            # all-reduce per global round instead of one per inner round
+            # (graft-lint collective-in-loop); the guarded denominator makes
+            # an empty padded group zeros (weight-0 at the cloud), not NaN
+            cw = cg.astype(jnp.float32)
+            cw_norm = cw / jnp.maximum(
+                jax.lax.psum(jnp.sum(cw), client_axis), 1e-12)
 
             def inner_round(gv, r_rng):
                 # same client-key table: split(r_rng, C)[c]
@@ -77,10 +89,9 @@ def build_sharded_hierarchical_round_fn(
                     gv, xg, yg, cg, crngs
                 )
                 # group-local weighted mean == psum over the clients axis
-                # (ICI); the shared helper's guarded denominator makes an
-                # empty padded group zeros (weight-0 at the cloud), not NaN
-                new_gv = tree_weighted_mean_psum(
-                    result.variables, cg.astype(jnp.float32), client_axis)
+                # (ICI), with the pre-normalized weights from above
+                new_gv = tree_weighted_sum_psum(
+                    result.variables, cw_norm, client_axis)
                 metrics = {
                     k: jax.lax.psum(v.sum(), client_axis)
                     for k, v in result.metrics.items()
@@ -105,7 +116,7 @@ def build_sharded_hierarchical_round_fn(
         return new_global, out_metrics
 
     def round_fn(global_variables, x, y, counts, rng):
-        sharded = jax.shard_map(
+        sharded = shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), P(group_axis, client_axis), P(group_axis, client_axis),

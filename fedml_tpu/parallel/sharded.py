@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.algorithms.engine import build_local_update
 from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.jax_compat import shard_map
 
 
 def build_sharded_round_fn(
@@ -54,9 +55,11 @@ def build_sharded_round_fn(
             global_variables, x, y, counts, crngs
         )
         # no client gather: the aggregator's sharded rule reduces locally
-        # weighted partial sums with param-sized psums over ICI (half the
-        # collective bytes of an all_gather of client stacks), and psum
-        # outputs are invariant-typed — shard_map's check_vma replication
+        # weighted partial sums with param-sized psums over ICI (at most half
+        # the collective bytes of an all_gather of client stacks — asserted
+        # against the lowered HLO inventory by tests/test_comms.py::
+        # test_psum_aggregation_halves_all_gather_bytes), and psum outputs
+        # are invariant-typed — shard_map's check_vma replication
         # verification stays ON (VERDICT r4 weak #3)
         new_global, new_state = aggregator.sharded(
             global_variables, result, counts.astype(jnp.float32), rng,
@@ -66,7 +69,7 @@ def build_sharded_round_fn(
         return new_global, new_state, metrics
 
     def round_fn(global_variables, agg_state, x, y, counts, rng):
-        sharded = jax.shard_map(
+        sharded = shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),

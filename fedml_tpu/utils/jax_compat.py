@@ -1,0 +1,41 @@
+"""jax version compatibility for the shard_map surface.
+
+The parallel modules are written against the modern jax API — `jax.shard_map`
+with check_vma varying-typing and `jax.lax.pcast` to mark scan carries as
+device-varying. Older jax (< 0.5, what some CI containers pin) only has
+`jax.experimental.shard_map.shard_map` with the boolean `check_rep` flag and
+no `pcast` at all. This module resolves both names once:
+
+- on modern jax it re-exports the native symbols untouched (check_vma stays
+  on — the machine-checked replication story in parallel/sharded.py holds);
+- on old jax it falls back to the experimental shard_map with replication
+  checking off (the old check_rep implementation rejects the vmap-of-psum
+  patterns every round here uses) and an identity `pcast` (there is no
+  varying-typing to satisfy, so the cast is purely a type annotation).
+
+Every shard_map/pcast call site in fedml_tpu imports from here, never from
+jax directly — that keeps the fallback decision in one place and lets the
+analysis layer lower the real round programs to HLO on either version.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+    def pcast(tree, axes, to="varying"):
+        del axes, to
+        return tree

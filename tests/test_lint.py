@@ -198,8 +198,38 @@ def test_ast_suppression_comment_silences_rule():
     src = (
         "import numpy as np\n"
         "def timeit(out):\n"
-        "    return float(np.asarray(out).ravel()[0])  # graft-lint: disable=sync-idiom\n")
+        "    return float(np.asarray(out).ravel()[0])"
+        "  # graft-lint: disable=sync-idiom -- intended one-shot timing sync\n")
     assert not _findings(src)
+
+
+def test_ast_bare_suppression_fires():
+    # the suppression still works, but the missing reason is its own finding
+    src = (
+        "import numpy as np\n"
+        "def timeit(out):\n"
+        "    return float(np.asarray(out).ravel()[0])"
+        "  # graft-lint: disable=sync-idiom\n")
+    findings = _findings(src)
+    assert [f.rule for f in findings] == ["bare-suppression"]
+    assert "sync-idiom" in findings[0].message
+
+
+def test_ast_reasoned_suppression_is_not_bare():
+    src = "x = 1  # graft-lint: disable=traced-loop -- static unroll\n"
+    assert not _findings(src)
+
+
+def test_suppression_reason_never_swallowed_into_rule_name():
+    # the regex must not parse 'traced-loop -- reason' as one rule name —
+    # that would silently disable the suppression itself
+    from fedml_tpu.analysis.core import suppressed_rules, suppression_reason
+    line = "x  # graft-lint: disable=traced-loop,sync-idiom -- both intended"
+    assert suppressed_rules(line) == {"traced-loop", "sync-idiom"}
+    assert suppression_reason(line) == "both intended"
+    assert suppressed_rules("x  # graft-lint: disable=sync-idiom") == {
+        "sync-idiom"}
+    assert suppression_reason("x  # graft-lint: disable=sync-idiom") is None
 
 
 def test_ast_untraced_code_is_not_flagged():

@@ -97,7 +97,7 @@ def run_convonly_rung(hw, cin, cout, depth=4):
     def chain_vmap(x, ws):
         def one(x, ws):
             # static depth-`depth` list — deliberate trace-time unroll
-            for w in ws:  # graft-lint: disable=traced-loop
+            for w in ws:  # graft-lint: disable=traced-loop -- static depth list, intended unroll
                 x = jax.nn.relu(jax.lax.conv_general_dilated(
                     x, w, (1, 1), "SAME",
                     dimension_numbers=("NHWC", "HWIO", "NHWC")))
@@ -109,7 +109,7 @@ def run_convonly_rung(hw, cin, cout, depth=4):
     def chain_grouped(x, ws):
         def one(x, *ws):
             # static depth-`depth` list — deliberate trace-time unroll
-            for w in ws:  # graft-lint: disable=traced-loop
+            for w in ws:  # graft-lint: disable=traced-loop -- static depth list, intended unroll
                 x = jax.nn.relu(conv(x, w))
             return x
         return jax.vmap(one)(x, *ws)

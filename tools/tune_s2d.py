@@ -92,7 +92,7 @@ def main():
     from fedml_tpu.data.packing import PackedClients
 
     # host-side data prep: one intended transfer of a tiny counts vector
-    cap = (int(np.asarray(ds.train.counts).min()) // BS) * BS  # graft-lint: disable=sync-idiom
+    cap = (int(np.asarray(ds.train.counts).min()) // BS) * BS  # graft-lint: disable=sync-idiom -- one intended host pull of a tiny counts vector
     ds = dataclasses.replace(
         ds, train=PackedClients(np.asarray(ds.train.x[:, :cap]),
                                 np.asarray(ds.train.y[:, :cap]),
