@@ -62,6 +62,21 @@ python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
   --epochs 1 --batch_size 4
 assert_summary "Test/Acc" 0.0 1.0
 
+echo "== fedavg chaos smoke (seeded drops + NaN faults, quarantine + guard)"
+# seed 7 deterministically drops clients and poisons others with NaN every
+# round; the masked round must quarantine the poisoned clients (nonzero
+# quarantined_count), still make progress on the survivors, and the guard
+# must accept every round (finite final loss)
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 2 \
+  --epochs 1 --batch_size 4 \
+  --chaos 1 --chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 --guard 1
+assert_summary "chaos_dropped" 1 7
+assert_summary "quarantined_count" 1 7
+assert_summary "participated_count" 1 7
+assert_summary "Test/Loss" 0 10
+assert_summary "Test/Acc" 0.0 1.0
+
 echo "== fedavg equivalence oracle: full-batch E=1 FedAvg == centralized"
 python - <<'EOF'
 # the reference CI's key trick (CI-script-fedavg.sh:44-50) as a direct check

@@ -55,6 +55,51 @@ log = logging.getLogger(__name__)
 FLAT_AGG_DEFAULT_BUDGET = 2 << 30
 
 
+def client_finite_mask(stacked_tree) -> jnp.ndarray:
+    """[C] bool: every inexact leaf of client c's stacked update is fully
+    finite. Integer/bool leaves (step counters, token tables) cannot carry
+    NaN/Inf and are skipped. Pure per-client reductions over trailing axes —
+    no collective, so the same mask works inside a shard_map body (where C is
+    the local shard) and under plain vmap."""
+    all_leaves = jax.tree.leaves(stacked_tree)
+    inexact = [l for l in all_leaves
+               if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not inexact:
+        return jnp.ones((all_leaves[0].shape[0],), bool)
+    per_leaf = [jnp.all(jnp.isfinite(l.reshape(l.shape[0], -1)), axis=1)
+                for l in inexact]
+    return jnp.stack(per_leaf, axis=0).all(axis=0)
+
+
+def quarantine_stage(result, weights, participation):
+    """Compose the participation mask with per-client finite-ness and zero
+    out dead rows BEFORE aggregation.
+
+    Returns (safe_result, masked_weights, alive, quarantined) where
+    alive = participating AND finite, quarantined = participating but
+    non-finite. Dead rows (dropped or quarantined) are zeroed with
+    `jnp.where` — never by multiplying with a zero weight, because
+    NaN * 0.0 == NaN and one poisoned client would contaminate every
+    weighted sum downstream. A zeroed row then contributes exact +0.0
+    terms to the aggregator's sequential weighted sums, which is what makes
+    a masked round bit-identical to aggregating the surviving cohort alone
+    (adding a floating-point identity is exact; pinned by
+    tests/test_robustness.py).
+    """
+    alive = participation.astype(bool) & client_finite_mask(result.variables)
+    quarantined = participation.astype(bool) & ~alive
+
+    def zero_dead(leaf):
+        keep = alive.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.where(keep, leaf, jnp.zeros((), leaf.dtype))
+
+    safe_vars = jax.tree.map(zero_dead, result.variables)
+    safe_metrics = {k: zero_dead(v) for k, v in result.metrics.items()}
+    safe_result = result._replace(variables=safe_vars, metrics=safe_metrics)
+    masked_weights = jnp.where(alive, weights, jnp.zeros((), weights.dtype))
+    return safe_result, masked_weights, alive, quarantined
+
+
 def tree_weighted_sum_psum(stacked_tree, weights, axis):
     """Cross-device weighted SUM: locally weight-sum the shard's clients,
     psum the param-sized partials over mesh `axis`. Callers own the weight

@@ -319,17 +319,49 @@ def build_round_fn_from_update(batched_update, aggregator) -> Callable:
 
     Mirrors the server loop at reference FedAvgServerManager.py:43-88
     (receive all -> aggregate -> broadcast) collapsed into one XLA program.
-    """
 
-    def round_fn(global_variables, agg_state, x, y, counts, rng):
+    The optional trailing `participation` ([C] bool/int, 1 = client reached
+    the round) arms fault tolerance: dropped clients and clients whose
+    trained variables contain NaN/Inf (quarantine — see
+    aggregators.quarantine_stage) are zero-weight `where`-zeroed rows in the
+    aggregation, bit-identical to aggregating the surviving cohort alone on
+    the same rng table, and the metrics gain `participated_count` /
+    `quarantined_count`. When every client is dropped or quarantined the
+    round degrades to a no-op: global variables AND aggregator state pass
+    through unchanged (no NaN escape). `participation=None` (the default)
+    traces the exact legacy program — no masking ops, no extra metric keys,
+    no retrace of existing callers; passing an array compiles one additional
+    specialization.
+    """
+    # function-level import: aggregators.make_server_optimizer imports
+    # engine.torch_adagrad, so the modules must not need each other at
+    # import time
+    from fedml_tpu.algorithms.aggregators import quarantine_stage
+
+    def round_fn(global_variables, agg_state, x, y, counts, rng,
+                 participation=None):
         crngs = jax.random.split(rng, x.shape[0])
         result = batched_update(global_variables, x, y, counts, crngs)
-        new_global, agg_state = aggregator(
-            global_variables, result, counts.astype(jnp.float32), rng, agg_state
+        weights = counts.astype(jnp.float32)
+        if participation is None:
+            new_global, new_state = aggregator(
+                global_variables, result, weights, rng, agg_state
+            )
+            # per-client metric sums -> federation totals
+            metrics = {k: v.sum() for k, v in result.metrics.items()}
+            return new_global, new_state, metrics
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator(
+            global_variables, result, weights, rng, agg_state
         )
-        # per-client metric sums -> federation totals
+        any_alive = jnp.any(alive)
+        new_global = tree_where(any_alive, new_global, global_variables)
+        new_state = tree_where(any_alive, new_state, agg_state)
         metrics = {k: v.sum() for k, v in result.metrics.items()}
-        return new_global, agg_state, metrics
+        metrics["participated_count"] = alive.sum().astype(jnp.float32)
+        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        return new_global, new_state, metrics
 
     return jax.jit(round_fn)
 

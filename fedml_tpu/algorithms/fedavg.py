@@ -27,6 +27,7 @@ from fedml_tpu.algorithms.engine import (
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.packing import pack_eval_batches, pad_clients
 from fedml_tpu.data.registry import FederatedDataset
+from fedml_tpu.robustness.chaos import apply_faults, summarize as chaos_summary
 from fedml_tpu.utils.checkpoint import Checkpointable
 
 log = logging.getLogger(__name__)
@@ -103,29 +104,90 @@ class FedAvgAPI(Checkpointable):
         self._test_batches = pack_eval_batches(*dataset.test_global, max(bs, 64))
 
     # ------------------------------------------------------------------ train
-    def train_one_round(self, round_idx: int) -> dict[str, Any]:
+    def train_one_round(self, round_idx: int, faults=None,
+                        rng_salt: int = 0) -> dict[str, Any]:
+        """One synchronous round. `faults` (robustness.chaos.FaultEvents for
+        this round's cohort) injects drops/NaN/corruption at the host
+        boundary and arms the in-round participation mask + quarantine;
+        `rng_salt` != 0 derives a fresh round rng (guard retries — salt 0
+        keeps the legacy stream bit-exactly)."""
         cfg = self.cfg
         idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
         x, y, counts = self.dataset.train.select(idx)
+        participation = None
+        if faults is not None:
+            x = apply_faults(faults, x)
+            participation = np.asarray(faults.participation, bool)
         if self.mesh is not None:
+            n_before = counts.shape[0]
             x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
+            if participation is not None and counts.shape[0] > n_before:
+                # padded rows are zero-count no-ops either way; marking them
+                # non-participating keeps participated_count honest
+                participation = np.concatenate(
+                    [participation,
+                     np.zeros(counts.shape[0] - n_before, bool)])
         rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
-        self.global_variables, self.agg_state, train_metrics = self.round_fn(
-            self.global_variables, self.agg_state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts), rng
-        )
+        if rng_salt:
+            rng = jax.random.fold_in(rng, rng_salt)
+        args = [self.global_variables, self.agg_state, jnp.asarray(x),
+                jnp.asarray(y), jnp.asarray(counts), rng]
+        if participation is not None:
+            args.append(jnp.asarray(participation))
+        self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
         return {k: float(v) for k, v in train_metrics.items()}
 
     def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
-              metrics_logger=None) -> list[dict[str, Any]]:
+              metrics_logger=None, chaos=None, guard=None) -> list[dict[str, Any]]:
+        """Drive loop. `chaos` (robustness.chaos.FaultPlan) injects a seeded
+        deterministic fault schedule per round; `guard`
+        (robustness.guard.RoundGuard) inspects every round and, on a bad
+        verdict, rolls back to the pre-round state through the Checkpointable
+        interface (`_ckpt_tree`/`_ckpt_load` on the in-memory snapshot — the
+        same tree `save_checkpoint` persists) and re-runs the round with a
+        fresh rng salt, up to `guard.max_retries` before accepting."""
         cfg = self.cfg
         start_round = 0
         if ckpt_dir:
             start_round = self.maybe_restore(ckpt_dir)
-        for round_idx in range(start_round, cfg.comm_round):
+        round_idx = start_round
+        retries = 0
+        while round_idx < cfg.comm_round:
             t0 = time.time()
-            train_metrics = self.train_one_round(round_idx)
+            faults = None
+            if chaos is not None:
+                n_cohort = min(cfg.client_num_per_round, self.dataset.client_num)
+                faults = chaos.events(round_idx, n_cohort)
+            snapshot = None
+            if guard is not None:
+                # jax pytrees are immutable: holding the refs IS the snapshot
+                snapshot = (self._ckpt_tree(), self._ckpt_meta())
+            train_metrics = self.train_one_round(round_idx, faults=faults,
+                                                 rng_salt=retries)
             jax.block_until_ready(self.global_variables)
+            if guard is not None:
+                total = max(train_metrics.get("total", 1.0), 1.0)
+                loss = train_metrics.get("loss_sum", 0.0) / total
+                verdict = guard.inspect(round_idx, loss, self.global_variables)
+                if not verdict.ok and retries < guard.max_retries:
+                    retries += 1
+                    log.warning("guard: %s — rolled back, retrying with "
+                                "fresh rng (%d/%d)", verdict.reason, retries,
+                                guard.max_retries)
+                    self._ckpt_load(*snapshot)
+                    continue
+                if not verdict.ok:
+                    log.warning("guard: %s — retries exhausted, accepting "
+                                "the round", verdict.reason)
             record = {"round": round_idx, "round_time": time.time() - t0}
+            if faults is not None:
+                record.update(chaos_summary(faults))
+                for k in ("participated_count", "quarantined_count"):
+                    if k in train_metrics:
+                        record[k] = train_metrics[k]
+            if guard is not None and retries:
+                record["guard_retries"] = retries
+            retries = 0
             if round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1:
                 record.update(self.local_test_on_all_clients(round_idx))
                 record.update(self.test_global(round_idx))
@@ -136,6 +198,7 @@ class FedAvgAPI(Checkpointable):
             if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
                 self.save_checkpoint(ckpt_dir, round_idx + 1)
             log.info("round %d: %s (train %s)", round_idx, {k: v for k, v in record.items() if k != "round"}, train_metrics)
+            round_idx += 1
         if ckpt_dir:
             self.save_checkpoint(ckpt_dir, cfg.comm_round)
         return self.history

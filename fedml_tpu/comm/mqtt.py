@@ -25,6 +25,7 @@ import threading
 from typing import Callable
 
 from fedml_tpu.comm.message import Message
+from fedml_tpu.robustness.retry import RetryError, RetryPolicy, call_with_retry
 
 log = logging.getLogger(__name__)
 
@@ -185,13 +186,22 @@ class MqttClient:
 
     def __init__(self, host: str, port: int, client_id: str,
                  keepalive: float = 60.0, reconnect: bool = True,
-                 reconnect_backoff: float = 0.2, reconnect_tries: int = 5):
+                 reconnect_backoff: float = 0.2, reconnect_tries: int = 12,
+                 reconnect_policy: RetryPolicy | None = None):
         self._addr = (host, port)
         self._client_id = client_id
         self._keepalive = keepalive
         self._reconnect = reconnect
-        self._backoff = reconnect_backoff
-        self._tries = reconnect_tries
+        # robustness.retry owns the backoff; the legacy knobs map onto it.
+        # No jitter here: with jitter every sleep can land near zero, so all
+        # attempts may burn in under a second while the broker is still
+        # restarting — and an exhausted reconnect kills the receive loop for
+        # good. Deterministic backoff makes the give-up horizon a guarantee
+        # (~2 min of patience at these defaults), and a per-process handful
+        # of clients has no retry herd worth spreading.
+        self._reconnect_policy = reconnect_policy or RetryPolicy(
+            max_attempts=reconnect_tries, base_delay=reconnect_backoff,
+            max_delay=30.0, jitter=False, retryable=(OSError,))
         self._cbs: dict[str, Callable[[str, bytes], None]] = {}
         self._pid = 0
         self._send_lock = threading.Lock()  # publish/subscribe from any thread
@@ -215,27 +225,32 @@ class MqttClient:
 
     def _try_reconnect(self) -> bool:
         """Rebuild the connection and re-subscribe every topic (paho's
-        on_connect-resubscribe pattern). Returns False when shut down or
-        out of retries."""
-        import time as _time
+        on_connect-resubscribe pattern), with capped-exponential-backoff +
+        full-jitter retries (robustness.retry — the shared policy also used
+        by data downloads). Returns False when shut down or out of retries."""
 
-        for attempt in range(self._tries):
-            if self._stop.is_set():
-                return False
-            _time.sleep(self._backoff * (2 ** attempt))
-            try:
-                sock = self._connect()
-                with self._send_lock:
-                    self._sock = sock
-                    for topic in list(self._cbs):
-                        self._pid = (self._pid % 0xFFFF) + 1
-                        sock.sendall(_subscribe_packet(self._pid, topic))
-                log.info("mqtt %s: reconnected (attempt %d)",
-                         self._client_id, attempt + 1)
-                return True
-            except OSError:
-                continue
-        return False
+        def reconnect_once():
+            sock = self._connect()
+            with self._send_lock:
+                self._sock = sock
+                for topic in list(self._cbs):
+                    self._pid = (self._pid % 0xFFFF) + 1
+                    sock.sendall(_subscribe_packet(self._pid, topic))
+
+        try:
+            call_with_retry(
+                reconnect_once,
+                policy=self._reconnect_policy,
+                abort=self._stop.is_set,
+                on_retry=lambda attempt, exc, delay: log.info(
+                    "mqtt %s: reconnect attempt %d failed (%s), next in "
+                    "%.2fs", self._client_id, attempt + 1, exc, delay),
+            )
+        except (RetryError, OSError):
+            return False
+        log.info("mqtt %s: reconnected and resubscribed %d topic(s)",
+                 self._client_id, len(self._cbs))
+        return True
 
     def _loop(self):
         while not self._stop.is_set():
@@ -253,7 +268,16 @@ class MqttClient:
                 topic = body[2:2 + tlen].decode()
                 cb = self._cbs.get(topic)
                 if cb is not None:
-                    cb(topic, body[2 + tlen:])
+                    try:
+                        cb(topic, body[2 + tlen:])
+                    except Exception:
+                        # a handler that publishes onto a just-severed socket
+                        # raises OSError here; letting it kill the receive
+                        # loop would permanently deafen the client — log and
+                        # keep receiving (reconnect + the server's resend
+                        # loop recover the lost exchange)
+                        log.exception("mqtt %s: subscriber callback failed "
+                                      "for topic %s", self._client_id, topic)
             elif ptype == SUBACK & 0xF0:
                 pid = struct.unpack(">H", body[:2])[0]
                 ev = self._pending_subacks.pop(pid, None)

@@ -45,6 +45,14 @@ class MyMessage:
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    # fault-tolerance extension (absent from the reference's message_define;
+    # messages without it are handled with the legacy counters, so the
+    # reference wire-format interop is unchanged): stamping the round makes
+    # sync/reply handling idempotent under resends — a duplicated sync
+    # retrains deterministically (rng is derived from the round index, not
+    # from how many messages the worker has seen) and a stale reply from a
+    # finished round is dropped instead of polluting the current aggregate
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
 
 
 def _client_sampling(round_idx: int, total: int, per_round: int) -> list[int]:
@@ -65,7 +73,8 @@ class MqttFedAvgServerManager:
 
     def __init__(self, host: str, port: int, worker_num: int,
                  global_variables, cfg: FedConfig, trainer=None,
-                 test_global=None, topic: str = "fedml"):
+                 test_global=None, topic: str = "fedml",
+                 resend_interval: float | None = None):
         self.cfg = cfg
         self.worker_num = worker_num
         self.global_variables = global_variables
@@ -75,6 +84,14 @@ class MqttFedAvgServerManager:
         self._lock = threading.Lock()
         self._model_dict: dict[int, object] = {}
         self._sample_num_dict: dict[int, float] = {}
+        # self-healing: the current round's worker->client assignment so the
+        # resend loop can re-sync stragglers whose sync/reply got lost when
+        # the broker died mid-exchange (round_idx stamping makes it safe)
+        self._assignment: dict[int, int] = {}
+        self._resend_type: int | None = None
+        self._resend_interval = resend_interval
+        if resend_interval is not None:
+            threading.Thread(target=self._resend_loop, daemon=True).start()
         if trainer is not None and test_global is not None:
             x, y = test_global
             self._test = (jnp.asarray(x), jnp.asarray(y))
@@ -97,18 +114,58 @@ class MqttFedAvgServerManager:
         idx = _client_sampling(
             self.round_idx, self.cfg.client_num_in_total, self.worker_num
         )
+        with self._lock:
+            self._assignment = {w: idx[w - 1]
+                                for w in range(1, self.worker_num + 1)}
+            self._resend_type = MyMessage.MSG_TYPE_S2C_INIT_CONFIG
         for worker in range(1, self.worker_num + 1):
             self._send_model(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, worker,
                              idx[worker - 1])
 
-    def _send_model(self, msg_type: int, worker: int, client_index: int):
+    def _send_model(self, msg_type: int, worker: int, client_index: int,
+                    round_idx: int | None = None):
         m = Message(msg_type, 0, worker)
         m.add_model_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_variables)
         m.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, str(client_index))
+        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+              str(self.round_idx if round_idx is None else round_idx))
         self.comm.send_message(m)
+
+    def _resend_loop(self):
+        """Periodically re-sync workers the current round is still waiting on.
+
+        Lost frames are the failure mode of a broker kill/restart: the comm
+        layer reconnects and resubscribes, but anything in flight during the
+        outage is gone and the round wedges. Duplicates are harmless — the
+        worker retrains deterministically from the stamped round_idx and the
+        server keys replies by sender, so a re-reply just overwrites with the
+        identical model.
+        """
+        while not self.done.wait(self._resend_interval):
+            with self._lock:
+                if self._resend_type is None:
+                    continue
+                pending = [(w, c) for w, c in self._assignment.items()
+                           if w not in self._model_dict]
+                msg_type = self._resend_type
+                # capture the round under the lock: if the round advances
+                # after release, these frames carry the old stamp and the
+                # workers' re-replies get dropped as stale, not aggregated
+                ridx = self.round_idx
+            for worker, client_index in pending:
+                try:
+                    self._send_model(msg_type, worker, client_index,
+                                     round_idx=ridx)
+                except OSError:  # broker mid-restart; next tick retries
+                    break
 
     def _handle_model(self, msg: Message):
         sender = msg.get_sender_id()
+        raw_ridx = msg.get_params().get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if raw_ridx is not None and int(raw_ridx) != self.round_idx:
+            log.info("dropping stale round-%s reply from worker %d "
+                     "(current round %d)", raw_ridx, sender, self.round_idx)
+            return
         variables = Message.decode_model_params(
             msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS), self.global_variables
         )
@@ -125,6 +182,7 @@ class MqttFedAvgServerManager:
             )
             self._model_dict.clear()
             self._sample_num_dict.clear()
+            self._resend_type = None  # round complete; pause resends
         w = nums / nums.sum()
         self.global_variables = jax.tree.map(
             lambda *leaves: sum(
@@ -148,6 +206,10 @@ class MqttFedAvgServerManager:
         idx = _client_sampling(
             self.round_idx, self.cfg.client_num_in_total, self.worker_num
         )
+        with self._lock:
+            self._assignment = {w: idx[w - 1]
+                                for w in range(1, self.worker_num + 1)}
+            self._resend_type = MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
         for worker in range(1, self.worker_num + 1):
             self._send_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                              worker, idx[worker - 1])
@@ -191,11 +253,18 @@ class MqttFedAvgClientManager:
             msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS), self.example_variables
         )
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        # round_idx stamp (absent from reference-format messages -> fall back
+        # to the legacy local counter, which equals the stamp when no frames
+        # were lost, so the rng stream is bit-identical): deriving the rng
+        # from the ROUND rather than from how many syncs this worker has seen
+        # makes a resent sync retrain to the exact same model
+        raw_ridx = msg.get_params().get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        ridx = self.rounds_trained if raw_ridx is None else int(raw_ridx)
         x = jnp.asarray(self.dataset.train.x[client_index])
         y = jnp.asarray(self.dataset.train.y[client_index])
         count = jnp.int32(self.dataset.train.counts[client_index])
         rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.cfg.seed), self.rounds_trained * 1000 + self.worker_id
+            jax.random.PRNGKey(self.cfg.seed), ridx * 1000 + self.worker_id
         )
         result = self._local_update(variables, x, y, count, rng)
         reply = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
@@ -204,9 +273,10 @@ class MqttFedAvgClientManager:
                                jax.device_get(result.variables))
         reply.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES,
                   int(self.dataset.train.counts[client_index]))
+        reply.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, str(ridx))
         self.comm.send_message(reply)
-        self.rounds_trained += 1
-        if self.rounds_trained == self.cfg.comm_round:
+        self.rounds_trained = max(self.rounds_trained, ridx + 1)
+        if self.rounds_trained >= self.cfg.comm_round:
             self.finished.set()
 
     def stop(self):
@@ -228,6 +298,7 @@ def run_mqtt_fedavg(dataset: FederatedDataset, trainer, cfg: FedConfig,
     server = MqttFedAvgServerManager(
         host, port, worker_num, jax.device_get(gv), cfg,
         trainer=trainer, test_global=dataset.test_global,
+        resend_interval=2.0,
     )
     shared_update = jax.jit(build_local_update(trainer, cfg))
     clients = [

@@ -28,7 +28,10 @@ import hashlib
 import json
 import os
 import sys
+import urllib.error
 import urllib.request
+
+from fedml_tpu.robustness.retry import RetryPolicy, call_with_retry
 
 # artifact catalog: dataset -> list of (relative target path, url, unpack)
 # URLs are the ones the reference's download scripts fetch. Google-Drive
@@ -105,6 +108,43 @@ CATALOG: dict[str, list[tuple[str, str, str | None]]] = {
 
 MANIFEST = "manifest.sha256.json"
 
+# transient network failures (resets, timeouts, 5xx) get capped-backoff
+# retries; permanent HTTP errors (404 and friends) fail immediately
+DOWNLOAD_POLICY = RetryPolicy(max_attempts=4, base_delay=1.0, max_delay=30.0,
+                              retryable=(OSError,))
+
+
+def _download(url: str, dst: str, fetcher=None, policy: RetryPolicy | None = None,
+              sleep=None, rng=None) -> None:
+    """One artifact download with retry (fetcher/sleep/rng injectable for
+    deterministic tests). HTTPError is an OSError subclass, so a plain
+    retryable=(OSError,) would retry a 404 forever — client errors other
+    than 429 are rewrapped as non-retryable RuntimeError instead."""
+    fetch_one = urllib.request.urlretrieve if fetcher is None else fetcher
+
+    def once():
+        try:
+            fetch_one(url, dst)  # noqa: S310 — catalog URLs only
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500 and e.code != 429:
+                raise RuntimeError(
+                    f"{url}: HTTP {e.code} {e.reason} — permanent, not "
+                    "retrying") from e
+            raise
+
+    kwargs = {}
+    if sleep is not None:
+        kwargs["sleep"] = sleep
+    if rng is not None:
+        kwargs["rng"] = rng
+    call_with_retry(
+        once,
+        policy=policy or DOWNLOAD_POLICY,
+        on_retry=lambda attempt, exc, delay: print(
+            f"  download failed ({exc}); retry {attempt} in {delay:.1f}s"),
+        **kwargs,
+    )
+
 
 def _sha256(path: str, chunk: int = 1 << 20) -> str:
     h = hashlib.sha256()
@@ -162,10 +202,18 @@ def _gdrive_retry_url(html_path: str, url: str) -> str:
     return url + "&confirm=" + (m.group(1) if m else "t")
 
 
-def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
+def fetch(dataset: str, data_dir: str, dry_run: bool = False,
+          retries: int | None = None) -> int:
     """Download the dataset's artifacts and record their sha256 manifest.
-    --dry_run prints what would run (the zero-egress-inspectable mode)."""
+    --dry_run prints what would run (the zero-egress-inspectable mode);
+    --retries overrides the per-artifact retry budget (default 4 attempts
+    with capped full-jitter backoff)."""
     entries = CATALOG[dataset]
+    policy = (DOWNLOAD_POLICY if retries is None
+              else RetryPolicy(max_attempts=max(1, retries),
+                               base_delay=DOWNLOAD_POLICY.base_delay,
+                               max_delay=DOWNLOAD_POLICY.max_delay,
+                               retryable=DOWNLOAD_POLICY.retryable))
     manifest = {}
     for rel, url, unpack in entries:
         dst = os.path.join(data_dir, rel)
@@ -188,7 +236,7 @@ def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
             # never leaves a partial file at dst that a re-run would skip
             # and bless into the manifest
             tmp = dst + ".part"
-            urllib.request.urlretrieve(url, tmp)  # noqa: S310 — catalog URLs only
+            _download(url, tmp, policy=policy)
             if _looks_like_html(tmp):
                 # Google-Drive uc?export=download answers large files with a
                 # virus-scan interstitial page; saving it would record the
@@ -196,7 +244,7 @@ def fetch(dataset: str, data_dir: str, dry_run: bool = False) -> int:
                 if "docs.google.com" in url:
                     retry = _gdrive_retry_url(tmp, url)
                     print(f"  Drive interstitial detected — retrying {retry}")
-                    urllib.request.urlretrieve(retry, tmp)  # noqa: S310
+                    _download(retry, tmp, policy=policy)
                 if _looks_like_html(tmp):
                     os.remove(tmp)
                     hint = (
@@ -284,11 +332,14 @@ def main(argv=None) -> int:
         sp.add_argument("--data_dir", default="./data")
         if cmd == "fetch":
             sp.add_argument("--dry_run", action="store_true")
+            sp.add_argument("--retries", type=int, default=None,
+                            help="attempts per artifact (default 4, "
+                                 "capped full-jitter backoff between)")
         if cmd == "stats":
             sp.add_argument("--clients", type=int, default=10)
     a = p.parse_args(argv)
     if a.cmd == "fetch":
-        return fetch(a.dataset, a.data_dir, a.dry_run)
+        return fetch(a.dataset, a.data_dir, a.dry_run, retries=a.retries)
     if a.cmd == "verify":
         return verify(a.dataset, a.data_dir)
     return stats(a.dataset, a.data_dir, a.clients)

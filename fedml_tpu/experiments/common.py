@@ -51,7 +51,38 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--fedprox_mu", type=float, default=0.0)
     parser.add_argument("--dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"])
+    # fault-tolerance drive-loop knobs (fedml_tpu.robustness)
+    parser.add_argument("--chaos", type=int, default=0,
+                        help="1 = inject a seeded deterministic fault "
+                             "schedule (drops/NaN/corruption) per round")
+    parser.add_argument("--chaos_seed", type=int, default=0)
+    parser.add_argument("--chaos_drop_rate", type=float, default=0.0)
+    parser.add_argument("--chaos_nan_rate", type=float, default=0.0)
+    parser.add_argument("--chaos_corrupt_rate", type=float, default=0.0)
+    parser.add_argument("--guard", type=int, default=0,
+                        help="1 = roll back + re-run rounds whose loss goes "
+                             "non-finite or spikes")
+    parser.add_argument("--guard_spike_factor", type=float, default=4.0)
+    parser.add_argument("--guard_max_retries", type=int, default=2)
     return parser
+
+
+def robustness_from_args(args):
+    """(FaultPlan | None, RoundGuard | None) from the --chaos/--guard flags."""
+    chaos = guard = None
+    if getattr(args, "chaos", 0):
+        from fedml_tpu.robustness.chaos import FaultPlan
+
+        chaos = FaultPlan(seed=args.chaos_seed,
+                          drop_rate=args.chaos_drop_rate,
+                          nan_rate=args.chaos_nan_rate,
+                          corrupt_rate=args.chaos_corrupt_rate)
+    if getattr(args, "guard", 0):
+        from fedml_tpu.robustness.guard import RoundGuard
+
+        guard = RoundGuard(spike_factor=args.guard_spike_factor,
+                           max_retries=args.guard_max_retries)
+    return chaos, guard
 
 
 def config_from_args(args) -> FedConfig:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import argparse
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
-from fedml_tpu.experiments.common import add_args, setup_run
+from fedml_tpu.experiments.common import add_args, robustness_from_args, setup_run
 from fedml_tpu.utils.logging import MetricsLogger
 
 
@@ -25,7 +25,9 @@ def main(argv=None, aggregator_name: str = "fedavg", extra_args=None):
     cfg, ds, trainer = setup_run(args)
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
     api = FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
-    history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger)
+    chaos, guard = robustness_from_args(args)
+    history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger,
+                        chaos=chaos, guard=guard)
     logger.finish()
     return history
 

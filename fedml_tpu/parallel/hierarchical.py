@@ -29,12 +29,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.algorithms.aggregators import (
+    client_finite_mask,
     tree_weighted_mean_psum,
     tree_weighted_sum_psum,
 )
 from fedml_tpu.algorithms.engine import build_local_update
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import pcast, shard_map
+from fedml_tpu.utils.pytree import tree_where
 
 
 def build_sharded_hierarchical_round_fn(
@@ -51,6 +53,21 @@ def build_sharded_hierarchical_round_fn(
     [G, C, n_max, ...]; G must divide by mesh.shape[group_axis] and C by
     mesh.shape[client_axis] (pad with zero-count clients / empty groups —
     weight-0 no-ops at both averaging levels).
+
+    Fault tolerance (optional trailing `participation`, [G, C] bool sharded
+    like counts) is two-level, matching the communication hierarchy: dropped
+    clients are `where`-zeroed zero-weight rows inside every inner round
+    (elementwise only — the group weight normalization psum stays hoisted
+    outside the inner scan, so no collective enters the loop), while the
+    non-finite quarantine runs at GROUP granularity at the cloud step: a
+    group whose final variables carry NaN/Inf — one poisoned client inside
+    an inner round contaminates its whole group's running mean, there is no
+    finer-grained recovery point — is excluded from the cloud average with
+    zero weight. All groups quarantined degrades to a no-op (global passes
+    through). `participation=None` traces the exact legacy program
+    (COMMS_BUDGET.json gates it); metrics of the masked specialization gain
+    `participated_count` (participating clients in surviving groups) and
+    `quarantined_count` (participating clients in quarantined groups).
     """
     # clients-axis pcast: each client's scan carries become varying over the
     # clients axis; the groups axis is handled at the inner-round scan below
@@ -58,7 +75,8 @@ def build_sharded_hierarchical_round_fn(
     g_dev = mesh.shape[group_axis]
     c_dev = mesh.shape[client_axis]
 
-    def shard_body(global_variables, x, y, counts, rng):
+    def shard_body(global_variables, x, y, counts, rng, participation=None):
+        masked = participation is not None
         g_loc, c_loc = x.shape[0], x.shape[1]
         g_total, c_total = g_loc * g_dev, c_loc * c_dev
         gidx = jax.lax.axis_index(group_axis)
@@ -67,7 +85,9 @@ def build_sharded_hierarchical_round_fn(
         all_grngs = jax.random.split(rng, g_total)
         grngs = jax.lax.dynamic_slice_in_dim(all_grngs, gidx * g_loc, g_loc)
 
-        def group_train(gv, xg, yg, cg, grng):
+        def group_train(gv, xg, yg, cg, grng, pg):
+            # pg: this group's [c_loc] participation row (unused — and
+            # dead-code-eliminated — on the unmasked trace)
             # inner-scan carry: starts as the invariant global broadcast,
             # exits varying over the groups axis (each group trains its own
             # line) — pcast so the carry types match under check_vma
@@ -78,6 +98,10 @@ def build_sharded_hierarchical_round_fn(
             # (graft-lint collective-in-loop); the guarded denominator makes
             # an empty padded group zeros (weight-0 at the cloud), not NaN
             cw = cg.astype(jnp.float32)
+            if masked:
+                # dropped clients: zero weight before the hoisted
+                # normalization, so the mask costs no loop-carried collective
+                cw = jnp.where(pg, cw, 0.0)
             cw_norm = cw / jnp.maximum(
                 jax.lax.psum(jnp.sum(cw), client_axis), 1e-12)
 
@@ -88,13 +112,24 @@ def build_sharded_hierarchical_round_fn(
                 result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
                     gv, xg, yg, cg, crngs
                 )
+                variables, mets = result.variables, result.metrics
+                if masked:
+                    # `where`-zero dropped rows (elementwise, no collective):
+                    # a zero weight alone cannot save the sum from a NaN row
+                    # (NaN * 0 == NaN)
+                    def zero_dropped(leaf):
+                        keep = pg.reshape((-1,) + (1,) * (leaf.ndim - 1))
+                        return jnp.where(keep, leaf, jnp.zeros((), leaf.dtype))
+
+                    variables = jax.tree.map(zero_dropped, variables)
+                    mets = {k: jnp.where(pg, v, jnp.zeros((), v.dtype))
+                            for k, v in mets.items()}
                 # group-local weighted mean == psum over the clients axis
                 # (ICI), with the pre-normalized weights from above
-                new_gv = tree_weighted_sum_psum(
-                    result.variables, cw_norm, client_axis)
+                new_gv = tree_weighted_sum_psum(variables, cw_norm, client_axis)
                 metrics = {
                     k: jax.lax.psum(v.sum(), client_axis)
-                    for k, v in result.metrics.items()
+                    for k, v in mets.items()
                 }
                 return new_gv, metrics
 
@@ -103,26 +138,68 @@ def build_sharded_hierarchical_round_fn(
             )
             return gv, {k: v[-1] for k, v in ms.items()}
 
-        group_vars, metrics = jax.vmap(group_train, in_axes=(None, 0, 0, 0, 0))(
-            global_variables, x, y, counts, grngs
+        # the trailing operand is the participation block when masked and a
+        # dummy (counts — unused, DCE'd) otherwise, keeping one group_train
+        part = participation if masked else counts
+        group_vars, metrics = jax.vmap(group_train, in_axes=(None, 0, 0, 0, 0, 0))(
+            global_variables, x, y, counts, grngs, part
         )
-        # cloud level: weighted mean over groups — the once-per-global-round
-        # cross-slice reduction
-        gw = jax.lax.psum(counts.sum(axis=1).astype(jnp.float32), client_axis)
-        new_global = tree_weighted_mean_psum(group_vars, gw, group_axis)
+        if not masked:
+            # cloud level: weighted mean over groups — the once-per-global-
+            # round cross-slice reduction
+            gw = jax.lax.psum(counts.sum(axis=1).astype(jnp.float32), client_axis)
+            new_global = tree_weighted_mean_psum(group_vars, gw, group_axis)
+            out_metrics = {
+                k: jax.lax.psum(v.sum(), group_axis) for k, v in metrics.items()
+            }
+            return new_global, out_metrics
+        pb = participation.astype(bool)
+        cw_all = jnp.where(pb, counts.astype(jnp.float32), 0.0)
+        gw = jax.lax.psum(cw_all.sum(axis=1), client_axis)
+        # group-level quarantine: one poisoned client contaminates its whole
+        # group's inner-round running mean, so the recovery granularity at
+        # the cloud is the group — non-finite groups get zero weight and
+        # `where`-zeroed variables
+        fin_g = client_finite_mask(group_vars)
+        gw_eff = jnp.where(fin_g, gw, 0.0)
+
+        def zero_bad_group(leaf):
+            keep = fin_g.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            return jnp.where(keep, leaf, jnp.zeros((), leaf.dtype))
+
+        new_global = tree_weighted_mean_psum(
+            jax.tree.map(zero_bad_group, group_vars), gw_eff, group_axis)
+        any_alive = jax.lax.psum(gw_eff.sum(), group_axis) > 0
+        new_global = tree_where(any_alive, new_global, global_variables)
+        # participating clients per local group, cloud-summed by survival
+        p_g = jax.lax.psum(pb.astype(jnp.float32).sum(axis=1), client_axis)
         out_metrics = {
-            k: jax.lax.psum(v.sum(), group_axis) for k, v in metrics.items()
+            k: jax.lax.psum(jnp.where(fin_g, v, jnp.zeros((), v.dtype)).sum(),
+                            group_axis)
+            for k, v in metrics.items()
         }
+        out_metrics["participated_count"] = jax.lax.psum(
+            jnp.where(fin_g, p_g, 0.0).sum(), group_axis)
+        out_metrics["quarantined_count"] = jax.lax.psum(
+            jnp.where(fin_g, 0.0, p_g).sum(), group_axis)
         return new_global, out_metrics
 
-    def round_fn(global_variables, x, y, counts, rng):
+    def round_fn(global_variables, x, y, counts, rng, participation=None):
+        data_spec = P(group_axis, client_axis)
+        if participation is None:
+            sharded = shard_map(
+                shard_body,
+                mesh=mesh,
+                in_specs=(P(), data_spec, data_spec, data_spec, P()),
+                out_specs=(P(), P()),
+            )
+            return sharded(global_variables, x, y, counts, rng)
         sharded = shard_map(
             shard_body,
             mesh=mesh,
-            in_specs=(P(), P(group_axis, client_axis), P(group_axis, client_axis),
-                      P(group_axis, client_axis), P()),
+            in_specs=(P(), data_spec, data_spec, data_spec, P(), data_spec),
             out_specs=(P(), P()),
         )
-        return sharded(global_variables, x, y, counts, rng)
+        return sharded(global_variables, x, y, counts, rng, participation)
 
     return jax.jit(round_fn)

@@ -26,6 +26,24 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
+# bounded by default: an unconfigured peer-wait must surface as an error in
+# minutes, not hang the pod forever (the reference's mpirun deployment hangs)
+DEFAULT_INIT_TIMEOUT = 300
+
+
+def _distributed_initialized() -> bool:
+    """jax.distributed.is_initialized arrived in newer jax; fall back to the
+    runtime's global client handle on versions without it."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
 
 def init_multihost(coordinator_address: str | None = None,
                    num_processes: int | None = None,
@@ -34,25 +52,37 @@ def init_multihost(coordinator_address: str | None = None,
     """Initialize the JAX distributed runtime (idempotent; no-op when
     unconfigured single-process). Returns topology info.
 
-    ``initialization_timeout`` (seconds) bounds how long a process waits for
-    missing peers at startup — a dead silo then surfaces as a clean
-    RuntimeError instead of an indefinite hang (the reference's mpirun
-    deployment just hangs; tests/test_multihost.py asserts the error)."""
+    ``initialization_timeout`` (seconds, default ``DEFAULT_INIT_TIMEOUT``)
+    bounds how long a process waits for missing peers at startup — a dead
+    silo then surfaces as a clean RuntimeError naming the coordinator and
+    this process's slot instead of an indefinite hang (the reference's
+    mpirun deployment just hangs; tests/test_multihost.py asserts the
+    error)."""
     if coordinator_address is not None:
-        if jax.distributed.is_initialized():
+        if _distributed_initialized():
             log.info("jax.distributed already initialized — skipping")
         else:
-            kwargs = {}
-            if initialization_timeout is not None:
-                kwargs["initialization_timeout"] = initialization_timeout
-            # no exception catching: a peer-wait timeout must propagate as
-            # the failure it is (tests/test_multihost.py defector case)
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                **kwargs,
-            )
+            timeout = (DEFAULT_INIT_TIMEOUT if initialization_timeout is None
+                       else initialization_timeout)
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=timeout,
+                )
+            except Exception as e:
+                # rewrap with the topology facts the operator needs to act
+                # (which silo is missing is almost always answerable from
+                # "who am I, who was I waiting for"); the original traceback
+                # rides along via __cause__
+                raise RuntimeError(
+                    f"jax.distributed.initialize timed out or failed after "
+                    f"{timeout}s (coordinator={coordinator_address}, "
+                    f"process_id={process_id}, num_processes={num_processes})"
+                    f": {e}. Check that every process slot is up and can "
+                    f"reach the coordinator address, then relaunch."
+                ) from e
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

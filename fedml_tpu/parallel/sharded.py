@@ -24,9 +24,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fedml_tpu.algorithms.aggregators import quarantine_stage
 from fedml_tpu.algorithms.engine import build_local_update
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import shard_map
+from fedml_tpu.utils.pytree import tree_where
 
 
 def build_sharded_round_fn(
@@ -41,11 +43,24 @@ def build_sharded_round_fn(
     Inputs mirror build_round_fn: x/y/counts have a leading client axis C which
     must be divisible by mesh.shape[axis] (pad with zero-count clients — they
     are weight-0 no-ops in every aggregator).
+
+    The optional trailing `participation` ([C] bool, sharded like counts)
+    arms in-round fault tolerance: dropped clients and non-finite
+    (quarantined) updates become `where`-zeroed zero-weight rows before the
+    psum partial sums, so a masked round is bit-identical to the unmasked
+    round over the zero-count-padded surviving cohort on the same geometry
+    and rng table (tests/test_robustness.py). All-dead rounds pass global
+    variables and aggregator state through unchanged. The default
+    `participation=None` traces the exact legacy program — COMMS_BUDGET.json
+    gates that program's collective counts/bytes, and the masked
+    specialization adds only two scalar psums (the participated/quarantined
+    counts).
     """
     local_update = build_local_update(trainer, cfg, pvary_axes=(axis,))
     n_dev = mesh.shape[axis]
 
-    def shard_body(global_variables, agg_state, x, y, counts, rng):
+    def shard_body(global_variables, agg_state, x, y, counts, rng,
+                   participation=None):
         c_local = x.shape[0]
         didx = jax.lax.axis_index(axis)
         # same key table as the vmap engine: split(rng, C)[d*c_local:(d+1)*c_local]
@@ -54,6 +69,10 @@ def build_sharded_round_fn(
         result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
             global_variables, x, y, counts, crngs
         )
+        weights = counts.astype(jnp.float32)
+        if participation is not None:
+            result, weights, alive, quarantined = quarantine_stage(
+                result, weights, participation)
         # no client gather: the aggregator's sharded rule reduces locally
         # weighted partial sums with param-sized psums over ICI (at most half
         # the collective bytes of an all_gather of client stacks — asserted
@@ -62,19 +81,39 @@ def build_sharded_round_fn(
         # are invariant-typed — shard_map's check_vma replication
         # verification stays ON (VERDICT r4 weak #3)
         new_global, new_state = aggregator.sharded(
-            global_variables, result, counts.astype(jnp.float32), rng,
-            agg_state, axis
+            global_variables, result, weights, rng, agg_state, axis
         )
         metrics = {k: jax.lax.psum(v.sum(), axis) for k, v in result.metrics.items()}
+        if participation is None:
+            return new_global, new_state, metrics
+        alive_total = jax.lax.psum(alive.sum(), axis)
+        # psum outputs are invariant-typed, so the no-op guard's select is
+        # invariant too and check_vma accepts the P() out_specs unchanged
+        any_alive = alive_total > 0
+        new_global = tree_where(any_alive, new_global, global_variables)
+        new_state = tree_where(any_alive, new_state, agg_state)
+        metrics["participated_count"] = alive_total.astype(jnp.float32)
+        metrics["quarantined_count"] = jax.lax.psum(
+            quarantined.sum(), axis).astype(jnp.float32)
         return new_global, new_state, metrics
 
-    def round_fn(global_variables, agg_state, x, y, counts, rng):
+    def round_fn(global_variables, agg_state, x, y, counts, rng,
+                 participation=None):
+        if participation is None:
+            sharded = shard_map(
+                shard_body,
+                mesh=mesh,
+                in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+                out_specs=(P(), P(), P()),
+            )
+            return sharded(global_variables, agg_state, x, y, counts, rng)
         sharded = shard_map(
             shard_body,
             mesh=mesh,
-            in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(axis)),
             out_specs=(P(), P(), P()),
         )
-        return sharded(global_variables, agg_state, x, y, counts, rng)
+        return sharded(global_variables, agg_state, x, y, counts, rng,
+                       participation)
 
     return jax.jit(round_fn)

@@ -1,0 +1,33 @@
+"""Fault-tolerance subsystem: retry/backoff, chaos injection, round guard.
+
+Production federated rounds are defined by partial participation — clients
+straggle, drop, and ship non-finite updates (SURVEY §5, §7). This package
+holds the host-side half of the fault story:
+
+- `retry`  — capped-exponential-backoff-with-full-jitter retry loop shared by
+  `comm/mqtt.py` (socket reconnects) and `data/acquire.py` (download retry).
+- `chaos`  — seeded, deterministic fault-schedule injector (drops, NaN
+  poisoning, value corruption) applied at the host boundary before dispatch.
+- `guard`  — driver-side loss-spike / non-finite-global detector that rolls
+  the run back to the last good state and re-runs the round with fresh rng.
+
+The device-side half (the static-shape `participation` mask and the
+non-finite update quarantine) lives in `algorithms/aggregators.py` and the
+round builders (`algorithms/engine.py`, `parallel/sharded.py`,
+`parallel/hierarchical.py`) so it compiles into the round programs.
+"""
+
+from fedml_tpu.robustness.chaos import FaultEvents, FaultPlan, apply_faults
+from fedml_tpu.robustness.guard import GuardVerdict, RoundGuard
+from fedml_tpu.robustness.retry import RetryError, RetryPolicy, call_with_retry
+
+__all__ = [
+    "FaultEvents",
+    "FaultPlan",
+    "apply_faults",
+    "GuardVerdict",
+    "RoundGuard",
+    "RetryError",
+    "RetryPolicy",
+    "call_with_retry",
+]

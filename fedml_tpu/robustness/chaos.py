@@ -1,0 +1,107 @@
+"""Seeded deterministic fault-schedule injector (the chaos harness).
+
+Faults are decided per round from `np.random.default_rng([seed, round_idx])`
+— a pure function of (plan, round_idx, n_clients), independent of execution
+history, so a crashed-and-resumed run or a guard-triggered re-run sees the
+identical schedule, and two runs with the same seed produce identical fault
+schedules and identical final metrics (ISSUE 4 acceptance criterion).
+
+Injection happens at the host boundary, before dispatch: dropped clients
+become zeros in the `participation` mask (the round program gives them zero
+aggregation weight — see engine.build_round_fn_from_update), NaN-poisoned
+clients get NaN written into their input rows (their grads go non-finite and
+the in-round quarantine stage excludes them), corrupted clients get a large
+multiplicative perturbation (finite garbage — exercises the guard's
+loss-spike detector rather than the quarantine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+
+
+class FaultEvents(NamedTuple):
+    """Host-side fault decisions for one round (numpy, length n_clients)."""
+
+    participation: np.ndarray  # bool — False = client dropped this round
+    nan_mask: np.ndarray  # bool — True = client's update poisoned with NaN
+    corrupt_mask: np.ndarray  # bool — True = client data corrupted (finite)
+
+    @property
+    def dropped(self) -> int:
+        return int((~self.participation).sum())
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Per-round fault rates plus optional per-round overrides.
+
+    overrides maps round_idx -> {"drop_rate": ..., "nan_rate": ...,
+    "corrupt_rate": ...} (missing keys inherit the plan-level rate), so a
+    test can script e.g. "round 3 loses everyone".
+    """
+
+    seed: int = 0
+    drop_rate: float = 0.0
+    nan_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    overrides: Dict[int, Dict[str, float]] = field(default_factory=dict)
+
+    def rates_for(self, round_idx: int) -> Dict[str, float]:
+        base = {"drop_rate": self.drop_rate, "nan_rate": self.nan_rate,
+                "corrupt_rate": self.corrupt_rate}
+        base.update(self.overrides.get(round_idx, {}))
+        return base
+
+    def events(self, round_idx: int, n_clients: int) -> FaultEvents:
+        """Deterministic fault decisions for this round's sampled cohort."""
+        rates = self.rates_for(round_idx)
+        rng = np.random.default_rng([self.seed, round_idx])
+        drop = rng.random(n_clients) < rates["drop_rate"]
+        nan = rng.random(n_clients) < rates["nan_rate"]
+        corrupt = rng.random(n_clients) < rates["corrupt_rate"]
+        # a dropped client never reaches the round program — its other
+        # faults are moot; keep the masks disjoint so counts add up
+        nan &= ~drop
+        corrupt &= ~drop & ~nan
+        return FaultEvents(participation=~drop, nan_mask=nan,
+                           corrupt_mask=corrupt)
+
+
+def apply_faults(events: FaultEvents, x: np.ndarray) -> np.ndarray:
+    """Perturb the cohort's packed input rows [C, n_max, ...] per `events`.
+
+    Only float inputs can carry NaN; for integer/token inputs the NaN fault
+    degrades to corruption (max-value fill) which still derails the client's
+    update without violating the dtype. Returns a copy; `x` is untouched.
+    """
+    x = np.asarray(x)
+    if not (events.nan_mask.any() or events.corrupt_mask.any()):
+        return x
+    out = np.array(x, copy=True)
+    is_float = np.issubdtype(out.dtype, np.floating)
+    for c in np.nonzero(events.nan_mask)[0]:
+        if is_float:
+            out[c] = np.nan
+        else:
+            out[c] = np.iinfo(out.dtype).max
+    for c in np.nonzero(events.corrupt_mask)[0]:
+        if is_float:
+            out[c] = out[c] * 1e3 + 7.0
+        else:
+            out[c] = (out[c] + 13) % max(int(out.max()) + 1, 2)
+    return out
+
+
+def summarize(events: Optional[FaultEvents]) -> Dict[str, int]:
+    """Host-side event counts for logging (all zeros when chaos is off)."""
+    if events is None:
+        return {"chaos_dropped": 0, "chaos_nan": 0, "chaos_corrupt": 0}
+    return {
+        "chaos_dropped": int((~events.participation).sum()),
+        "chaos_nan": int(events.nan_mask.sum()),
+        "chaos_corrupt": int(events.corrupt_mask.sum()),
+    }

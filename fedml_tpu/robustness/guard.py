@@ -1,0 +1,84 @@
+"""Driver-side round guard: loss-spike / non-finite-global detection.
+
+The in-round quarantine (algorithms/aggregators.py) stops per-client NaN from
+entering the aggregate; the guard is the outer line of defense for what
+quarantine cannot see — finite-but-garbage updates (corrupted data, poisoned
+clients below the attack-detection threshold) that send the global loss off a
+cliff, and any non-finite value that reaches the global model through a path
+without quarantine. The drive loop (algorithms/fedavg.py FedAvgAPI.train)
+consults the guard after every round; on a bad verdict it rolls back to the
+last good state (checkpoint via the existing Checkpointable machinery when
+available, otherwise the in-memory pre-round snapshot) and re-runs the round
+with a fresh rng salt.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GuardVerdict(NamedTuple):
+    ok: bool
+    reason: str  # "" when ok
+
+
+@jax.jit
+def _all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every inexact leaf of the pytree is fully finite."""
+    leaves = [l for l in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+
+
+@dataclass
+class RoundGuard:
+    """Flags a round when the train loss goes non-finite, the global model
+    picks up a non-finite leaf, or the loss spikes past `spike_factor` x the
+    median of the last `window` accepted losses (needs >= `min_history`
+    accepted rounds before the spike test arms — early training is noisy).
+
+    `max_retries` bounds how many times the drive loop re-runs one round on
+    a bad verdict before accepting it and moving on (a permanently-poisoned
+    cohort must not livelock the run).
+    """
+
+    spike_factor: float = 4.0
+    window: int = 8
+    min_history: int = 3
+    max_retries: int = 2
+
+    def __post_init__(self):
+        self._losses: deque = deque(maxlen=self.window)
+
+    def inspect(self, round_idx: int, loss: float,
+                global_variables: Optional[Any] = None) -> GuardVerdict:
+        """Judge one completed round. Accepted losses enter the history;
+        rejected rounds leave it untouched (a spike must not poison the
+        baseline it is judged against)."""
+        loss = float(loss)
+        if not np.isfinite(loss):
+            return GuardVerdict(False, f"round {round_idx}: non-finite train "
+                                       f"loss ({loss})")
+        if global_variables is not None and not bool(
+                _all_finite(global_variables)):
+            return GuardVerdict(False, f"round {round_idx}: non-finite leaf "
+                                       f"in global variables")
+        if len(self._losses) >= self.min_history:
+            baseline = float(np.median(self._losses))
+            if baseline > 0 and loss > self.spike_factor * baseline:
+                return GuardVerdict(
+                    False, f"round {round_idx}: loss {loss:.4g} spiked past "
+                           f"{self.spike_factor}x median {baseline:.4g}")
+        self._losses.append(loss)
+        return GuardVerdict(True, "")
+
+    def reset(self):
+        self._losses.clear()

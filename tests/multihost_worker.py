@@ -45,7 +45,7 @@ def main():
     )
 
     info = init_multihost(f"localhost:{port}", nproc, pid,
-                          initialization_timeout=30 if mode == "defect" else None)
+                          initialization_timeout=10 if mode == "defect" else None)
     assert info["process_count"] == nproc, info
     assert info["global_device_count"] == 8, info
     assert info["local_device_count"] == n_local, info

@@ -8,6 +8,7 @@ import time
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.comm import Message, MiniBroker, MqttClient, MqttCommManager
 
@@ -181,6 +182,142 @@ def test_mqtt_client_reconnects_and_resubscribes():
         assert got[-1] == b"after"
         for c in (sub, pub):
             c.disconnect()
+    finally:
+        broker.close()
+
+
+@pytest.mark.slow  # ~60-120s: one jit compile + a real 3-round outage drill;
+# tier-1 keeps the fast halves (client reconnect+resubscribe above, the
+# idempotent resent-sync below) inside the suite's wall-clock budget
+def test_fedavg_survives_broker_kill_and_restart_mid_exchange():
+    """ISSUE 4 satellite: kill the broker mid-round and restart it on the
+    same port. The clients' retry-policy reconnect + resubscribe
+    (robustness.retry) and the server's round-stamped resend loop must
+    complete every round — frames lost in the outage are re-sent, duplicate
+    syncs retrain deterministically (rng derives from the stamped round
+    index), and stale replies are dropped."""
+    import jax
+
+    from fedml_tpu.algorithms.engine import build_local_update
+    from fedml_tpu.comm.mqtt_fedavg import (
+        MqttFedAvgClientManager,
+        MqttFedAvgServerManager,
+    )
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.registry import create_model
+
+    ds = load_dataset("mnist", client_num_in_total=2, partition_method="homo",
+                      seed=0)
+    cfg = FedConfig(dataset="mnist", model="lr", client_num_in_total=2,
+                    client_num_per_round=2, comm_round=3, batch_size=32,
+                    lr=0.1)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    gv = trainer.init(jax.random.PRNGKey(cfg.seed),
+                      jnp.asarray(ds.train.x[0][:1]))
+
+    broker = MiniBroker()
+    host, port = broker.host, broker.port
+    server = clients = None
+    try:
+        server = MqttFedAvgServerManager(
+            host, port, 2, jax.device_get(gv), cfg, trainer=trainer,
+            test_global=ds.test_global, resend_interval=0.5)
+        shared = jax.jit(build_local_update(trainer, cfg))
+        # warm the jit cache before the exchange starts: otherwise the first
+        # sync compiles for ~30s inside the callback thread while the resend
+        # loop floods duplicate (idempotent, but slow) syncs
+        jax.block_until_ready(shared(
+            gv, jnp.asarray(ds.train.x[0]), jnp.asarray(ds.train.y[0]),
+            jnp.int32(ds.train.counts[0]), jax.random.PRNGKey(0)))
+        clients = [
+            MqttFedAvgClientManager(host, port, k, ds, trainer, cfg, gv,
+                                    local_update=shared)
+            for k in (1, 2)
+        ]
+        server.send_init_msg()
+        # let round 0 complete so the kill lands mid-exchange of a later round
+        deadline = time.time() + 120
+        while len(server.history) < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(server.history) >= 1, "round 0 never finished"
+
+        # kill: close the listener and shutdown every established connection
+        # (shutdown, not close — a close with the serve thread blocked in
+        # recv leaves the kernel socket holding the port), then restart on
+        # the SAME port once the teardown lands
+        old = broker
+        old.close()
+        for s in list(old._send_locks):
+            try:
+                s.shutdown(2)
+            except OSError:
+                pass
+        for _ in range(200):
+            try:
+                broker = MiniBroker(host, port)
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            pytest.fail("could not rebind the broker port")
+
+        assert server.done.wait(120), (
+            f"run wedged after broker restart: history={server.history}")
+        assert len(server.history) == cfg.comm_round
+        assert all(np.isfinite(r["test_loss"]) for r in server.history)
+        assert server.history[-1]["test_acc"] > 0.3
+    finally:
+        if clients:
+            for c in clients:
+                c.stop()
+        if server:
+            server.stop()
+        broker.close()
+
+
+def test_mqtt_fedavg_client_resent_sync_is_idempotent():
+    """A duplicated/resent sync for the same round must produce a bitwise
+    identical reply (rng derives from the stamped round index, not a local
+    message counter) and must not advance the client's round counter twice."""
+    import jax
+
+    from fedml_tpu.comm.mqtt_fedavg import MqttFedAvgClientManager, MyMessage
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.registry import create_model
+
+    ds = load_dataset("mnist", client_num_in_total=2, partition_method="homo",
+                      seed=0)
+    cfg = FedConfig(dataset="mnist", model="lr", client_num_in_total=2,
+                    client_num_per_round=1, comm_round=5, batch_size=32,
+                    lr=0.1)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    gv = trainer.init(jax.random.PRNGKey(cfg.seed),
+                      jnp.asarray(ds.train.x[0][:1]))
+
+    broker = MiniBroker()
+    try:
+        client = MqttFedAvgClientManager(broker.host, broker.port, 1, ds,
+                                         trainer, cfg, gv)
+        sent = []
+        client.comm.send_message = lambda m: sent.append(m)
+
+        sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        sync.add_model_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                              jax.device_get(gv))
+        sync.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, "0")
+        sync.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, "2")
+
+        client._train_and_reply(sync)
+        client._train_and_reply(sync)  # the resend
+        assert len(sent) == 2
+        assert sent[0].to_json() == sent[1].to_json()  # bitwise on the wire
+        assert sent[0].get(MyMessage.MSG_ARG_KEY_ROUND_IDX) == "2"
+        assert client.rounds_trained == 3  # ridx + 1, not += per message
+        client.stop()
     finally:
         broker.close()
 

@@ -48,6 +48,46 @@ def _communicate(procs, timeout):
     return outs
 
 
+def test_init_timeout_default_and_error_wrapping(monkeypatch):
+    """ISSUE 4 satellite: init_multihost passes initialization_timeout
+    through to jax.distributed.initialize — defaulting to 300s when unset —
+    and rewraps a startup failure into a RuntimeError naming the
+    coordinator and process slot (the facts an operator needs)."""
+    import jax
+
+    from fedml_tpu.parallel import multihost
+
+    calls = {}
+    monkeypatch.setattr(multihost, "_distributed_initialized", lambda: False)
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None, initialization_timeout=None):
+        calls.update(coordinator_address=coordinator_address,
+                     num_processes=num_processes, process_id=process_id,
+                     initialization_timeout=initialization_timeout)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    info = multihost.init_multihost("localhost:1234", 2, 0)
+    assert calls["initialization_timeout"] == multihost.DEFAULT_INIT_TIMEOUT == 300
+    assert info["process_count"] >= 1
+
+    multihost.init_multihost("localhost:1234", 2, 0,
+                             initialization_timeout=7)
+    assert calls["initialization_timeout"] == 7
+
+    def dead_peer_init(**kw):
+        raise RuntimeError("barrier wait deadline exceeded")
+
+    monkeypatch.setattr(jax.distributed, "initialize", dead_peer_init)
+    with pytest.raises(RuntimeError) as ei:
+        multihost.init_multihost("badhost:9999", 2, 1,
+                                 initialization_timeout=5)
+    msg = str(ei.value)
+    assert "timed out" in msg and "badhost:9999" in msg
+    assert "process_id=1" in msg and "num_processes=2" in msg
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_distributed_round_n_processes(nproc):
     """Control plane + sharded FedAvg + two-level hierarchical mesh +
@@ -64,10 +104,10 @@ def test_distributed_round_n_processes(nproc):
 def test_dead_process_fails_cleanly():
     """Failure detection: when a silo never joins, the surviving processes
     must terminate with a clear startup-timeout error — bounded by
-    init_multihost(initialization_timeout=30) — not hang (the reference's
+    init_multihost(initialization_timeout=10) — not hang (the reference's
     mpirun deployment hangs until the scheduler kills it)."""
     procs = _spawn_workers(2, mode="defect")
-    outs = _communicate(procs, timeout=180)
+    outs = _communicate(procs, timeout=120)
     # worker 1 defects by design
     assert procs[1].returncode == 0 and "DEFECTOR" in outs[1]
     # worker 0 must FAIL (not hang, not succeed), with a timeout diagnostic
