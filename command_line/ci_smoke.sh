@@ -56,11 +56,27 @@ python -m fedml_tpu.analysis --comms --fast --json COMMS.json
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 
-echo "== fedavg standalone smoke (2 clients, 1 round, batch 4)"
+echo "== fedavg standalone smoke (2 clients, 1 round, batch 4, eager loop)"
 python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
   --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
-  --epochs 1 --batch_size 4
+  --epochs 1 --batch_size 4 --pipeline_depth 0
 assert_summary "Test/Acc" 0.0 1.0
+cp "$RUN_DIR/wandb-summary.json" /tmp/ci_smoke_eager_summary.json
+
+echo "== fedavg pipelined smoke (depth-2 async drive loop == eager, CLI level)"
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
+  --epochs 1 --batch_size 4 --pipeline_depth 2
+python - "$RUN_DIR" <<'EOF'
+import json, sys
+with open("/tmp/ci_smoke_eager_summary.json") as f:
+    eager = json.load(f)
+with open(f"{sys.argv[1]}/wandb-summary.json") as f:
+    piped = json.load(f)
+for k in ("Test/Acc", "Test/Loss", "Train/Acc", "Train/Loss"):
+    assert piped.get(k) == eager.get(k), (k, eager.get(k), piped.get(k))
+print("OK pipelined == eager:", {k: piped[k] for k in ("Test/Acc", "Test/Loss") if k in piped})
+EOF
 
 echo "== fedavg chaos smoke (seeded drops + NaN faults, quarantine + guard)"
 # seed 7 deterministically drops clients and poisons others with NaN every

@@ -23,6 +23,7 @@ state is not polluted (SURVEY §7 hard part (b)).
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -312,7 +313,8 @@ def _vmapped_update(trainer, cfg: FedConfig) -> Callable:
     return batched
 
 
-def build_round_fn_from_update(batched_update, aggregator) -> Callable:
+def build_round_fn_from_update(batched_update, aggregator,
+                               donate_data: bool = False) -> Callable:
     """Jitted synchronous round over any batched client update (the vmap
     engine below, or the silo-grouped update in algorithms/silo_grouped.py —
     one definition of the rng stream and metrics contract for both).
@@ -332,6 +334,14 @@ def build_round_fn_from_update(batched_update, aggregator) -> Callable:
     traces the exact legacy program — no masking ops, no extra metric keys,
     no retrace of existing callers; passing an array compiles one additional
     specialization.
+
+    `donate_data=True` donates the (x, y, counts) cohort buffers into the
+    round — the pipelined drive loop stages a FRESH device copy per round,
+    so XLA may reuse that HBM in place. Donation is strictly opt-in: callers
+    that re-feed the same buffers across rounds (bench.py holds one staged
+    cohort for every timed rep) would hit deleted-buffer errors. Donation
+    never changes the traced program, only buffer aliasing, so donated and
+    undonated rounds are bit-identical.
     """
     # function-level import: aggregators.make_server_optimizer imports
     # engine.torch_adagrad, so the modules must not need each other at
@@ -363,12 +373,28 @@ def build_round_fn_from_update(batched_update, aggregator) -> Callable:
         metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
         return new_global, new_state, metrics
 
-    return jax.jit(round_fn)
+    if not donate_data:
+        return jax.jit(round_fn)
+
+    jitted = jax.jit(round_fn, donate_argnums=(2, 3, 4))
+
+    def donating_round_fn(*args, **kwargs):
+        # backends that can't alias a donated input (CPU for some
+        # shapes/dtypes) warn per compile; the fallback is a plain copy, so
+        # the warning is noise for this opt-in path
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*onat")
+            return jitted(*args, **kwargs)
+
+    donating_round_fn.jitted = jitted  # graft-lint donation introspection
+    return donating_round_fn
 
 
-def build_round_fn(trainer, cfg: FedConfig, aggregator) -> Callable:
+def build_round_fn(trainer, cfg: FedConfig, aggregator,
+                   donate_data: bool = False) -> Callable:
     """Jitted synchronous round: vmap(local_update) + aggregate."""
-    return build_round_fn_from_update(_vmapped_update(trainer, cfg), aggregator)
+    return build_round_fn_from_update(_vmapped_update(trainer, cfg),
+                                      aggregator, donate_data=donate_data)
 
 
 def build_chunked_round_runner(trainer, cfg: FedConfig, aggregator,

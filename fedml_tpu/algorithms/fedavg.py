@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -26,11 +27,18 @@ from fedml_tpu.algorithms.engine import (
 )
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.packing import pack_eval_batches, pad_clients
+from fedml_tpu.data.prefetch import CohortPrefetcher, StagedCohort
 from fedml_tpu.data.registry import FederatedDataset
 from fedml_tpu.robustness.chaos import apply_faults, summarize as chaos_summary
 from fedml_tpu.utils.checkpoint import Checkpointable
 
 log = logging.getLogger(__name__)
+
+
+def _scalar(v):
+    """Host scalar from an already-fetched record value (numpy after
+    jax.device_get); host ints/floats/strings pass through."""
+    return float(v) if hasattr(v, "dtype") else v
 
 
 def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
@@ -88,7 +96,13 @@ class FedAvgAPI(Checkpointable):
                 silo_trainer(model_trainer, config.silo_threshold),
                 config, self.aggregator)
         else:
-            self.round_fn = build_round_fn(model_trainer, config, self.aggregator)
+            # the pipelined drive loop stages a fresh device copy of the
+            # cohort every round, so its buffers can be donated into the
+            # round; eager callers (bench.py re-feeds one staged cohort)
+            # keep the non-donating default
+            self.round_fn = build_round_fn(
+                model_trainer, config, self.aggregator,
+                donate_data=config.pipeline_depth > 0)
         self.eval_fn = build_eval_fn(model_trainer)
         self.client_eval_fn = build_client_eval_fn(model_trainer)
         self._fed_eval_fn = build_federation_eval_fn(model_trainer)
@@ -135,7 +149,9 @@ class FedAvgAPI(Checkpointable):
         if participation is not None:
             args.append(jnp.asarray(participation))
         self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
-        return {k: float(v) for k, v in train_metrics.items()}
+        # ONE host round trip for the whole metrics dict — per-key float()
+        # was one blocking transfer per metric through the driver tunnel
+        return {k: float(v) for k, v in jax.device_get(train_metrics).items()}
 
     def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
               metrics_logger=None, chaos=None, guard=None) -> list[dict[str, Any]]:
@@ -145,11 +161,32 @@ class FedAvgAPI(Checkpointable):
         verdict, rolls back to the pre-round state through the Checkpointable
         interface (`_ckpt_tree`/`_ckpt_load` on the in-memory snapshot — the
         same tree `save_checkpoint` persists) and re-runs the round with a
-        fresh rng salt, up to `guard.max_retries` before accepting."""
+        fresh rng salt, up to `guard.max_retries` before accepting.
+
+        `cfg.pipeline_depth > 0` switches to the asynchronous round pipeline
+        (`_train_pipelined`): cohort t+k staged by a background thread while
+        round t executes, staged buffers donated into `round_fn`, metrics
+        resolved in one deferred `jax.device_get`. Bit-identical to the
+        eager loop at any depth — tests/test_pipeline.py."""
         cfg = self.cfg
         start_round = 0
         if ckpt_dir:
             start_round = self.maybe_restore(ckpt_dir)
+        if cfg.pipeline_depth > 0:
+            self._train_pipelined(start_round, ckpt_dir, ckpt_every,
+                                  metrics_logger, chaos, guard)
+        else:
+            self._train_eager(start_round, ckpt_dir, ckpt_every,
+                              metrics_logger, chaos, guard)
+        if ckpt_dir:
+            self.save_checkpoint(ckpt_dir, cfg.comm_round)
+        return self.history
+
+    def _train_eager(self, start_round, ckpt_dir, ckpt_every, metrics_logger,
+                     chaos, guard) -> None:
+        """Legacy synchronous drive loop: stage, dispatch, block, resolve —
+        every phase serialized against the device."""
+        cfg = self.cfg
         round_idx = start_round
         retries = 0
         while round_idx < cfg.comm_round:
@@ -199,9 +236,149 @@ class FedAvgAPI(Checkpointable):
                 self.save_checkpoint(ckpt_dir, round_idx + 1)
             log.info("round %d: %s (train %s)", round_idx, {k: v for k, v in record.items() if k != "round"}, train_metrics)
             round_idx += 1
-        if ckpt_dir:
-            self.save_checkpoint(ckpt_dir, cfg.comm_round)
-        return self.history
+
+    # ------------------------------------------------------- pipelined train
+    def _stage_cohort(self, round_idx: int, chaos=None) -> StagedCohort:
+        """Host half of one round as a pure function of `round_idx`: sample
+        -> gather -> chaos faults + participation mask -> mesh pad ->
+        non-blocking `jax.device_put`. Runs on the prefetcher's staging
+        thread; mirrors `train_one_round`'s host path exactly (the
+        pipelined == eager bit-identity pin depends on it)."""
+        cfg = self.cfg
+        idx = client_sampling(round_idx, self.dataset.client_num,
+                              cfg.client_num_per_round)
+        faults = chaos.events(round_idx, len(idx)) if chaos is not None else None
+        x, y, counts = self.dataset.train.select(idx)
+        participation = None
+        if faults is not None:
+            x = apply_faults(faults, x)
+            participation = np.asarray(faults.participation, bool)
+        if self.mesh is not None:
+            n_before = counts.shape[0]
+            x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
+            if participation is not None and counts.shape[0] > n_before:
+                participation = np.concatenate(
+                    [participation,
+                     np.zeros(counts.shape[0] - n_before, bool)])
+        dx, dy, dc = (jax.device_put(x), jax.device_put(y),
+                      jax.device_put(counts))
+        dp = jax.device_put(participation) if participation is not None else None
+        return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
+
+    def _train_pipelined(self, start_round, ckpt_dir, ckpt_every,
+                         metrics_logger, chaos, guard) -> None:
+        """Asynchronous drive loop (`cfg.pipeline_depth` > 0).
+
+        While round t executes, a background stager prepares cohorts
+        t+1..t+depth (`_stage_cohort` via data.prefetch.CohortPrefetcher);
+        the staged device buffers are donated into `round_fn`; train metrics
+        stay device-resident and are resolved in ONE deferred
+        `jax.device_get` per flush — forced early only when the guard needs
+        the loss, or on test/checkpoint rounds. A deque of in-flight metric
+        trees bounds host run-ahead to `pipeline_depth` dispatched rounds.
+
+        Guard rollback restores the snapshot, DROPS every in-flight prefetch
+        (`invalidate` — the rejected round's buffers were donated and gone),
+        and re-stages the retried round on demand; staging is pure in
+        round_idx, so the retry sees byte-identical inputs plus the salted
+        rng, exactly like the eager loop."""
+        cfg = self.cfg
+        prefetcher = CohortPrefetcher(
+            lambda r: self._stage_cohort(r, chaos), depth=cfg.pipeline_depth)
+        self._last_prefetcher = prefetcher  # test/ops introspection
+        pending: list[dict[str, Any]] = []  # records w/ device-array metrics
+        inflight: deque = deque()
+
+        def flush():
+            if not pending:
+                return
+            for rec in jax.device_get(pending):
+                rec = {k: _scalar(v) for k, v in rec.items()}
+                self.history.append(rec)
+                if metrics_logger is not None:
+                    metrics_logger.log(
+                        {k: v for k, v in rec.items() if k != "round"},
+                        step=rec["round"])
+                log.info("round %d: %s", rec["round"],
+                         {k: v for k, v in rec.items() if k != "round"})
+            pending.clear()
+
+        round_idx = start_round
+        retries = 0
+        try:
+            while round_idx < cfg.comm_round:
+                t0 = time.time()
+                staged = prefetcher.get(round_idx)
+                # a rolled-back timeline can never leak a stale cohort in
+                assert staged.round_idx == round_idx
+                for ahead in range(1, cfg.pipeline_depth + 1):
+                    if round_idx + ahead < cfg.comm_round:
+                        prefetcher.prefetch(round_idx + ahead)
+                snapshot = None
+                if guard is not None:
+                    snapshot = (self._ckpt_tree(), self._ckpt_meta())
+                rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
+                                         round_idx)
+                if retries:
+                    rng = jax.random.fold_in(rng, retries)
+                args = [self.global_variables, self.agg_state, staged.x,
+                        staged.y, staged.counts, rng]
+                if staged.participation is not None:
+                    args.append(staged.participation)
+                self.global_variables, self.agg_state, train_metrics = \
+                    self.round_fn(*args)
+                inflight.append(train_metrics)
+                if len(inflight) > cfg.pipeline_depth:
+                    # rounds are serialized on device by the global-variables
+                    # dependency, so round t-depth is long done — blocking on
+                    # its tiny metric tree bounds run-ahead without stalling
+                    jax.block_until_ready(inflight.popleft())
+                is_test = (round_idx % cfg.frequency_of_the_test == 0
+                           or round_idx == cfg.comm_round - 1)
+                is_ckpt = bool(ckpt_dir) and (round_idx + 1) % ckpt_every == 0
+                if guard is not None:
+                    train_metrics = {
+                        k: float(v)
+                        for k, v in jax.device_get(train_metrics).items()}
+                    total = max(train_metrics.get("total", 1.0), 1.0)
+                    loss = train_metrics.get("loss_sum", 0.0) / total
+                    verdict = guard.inspect(round_idx, loss,
+                                            self.global_variables)
+                    if not verdict.ok and retries < guard.max_retries:
+                        retries += 1
+                        log.warning("guard: %s — rolled back, retrying with "
+                                    "fresh rng (%d/%d)", verdict.reason,
+                                    retries, guard.max_retries)
+                        self._ckpt_load(*snapshot)
+                        prefetcher.invalidate()
+                        inflight.clear()
+                        continue
+                    if not verdict.ok:
+                        log.warning("guard: %s — retries exhausted, "
+                                    "accepting the round", verdict.reason)
+                record = {"round": round_idx, "round_time": time.time() - t0}
+                if staged.faults is not None:
+                    record.update(chaos_summary(staged.faults))
+                    for k in ("participated_count", "quarantined_count"):
+                        if k in train_metrics:
+                            record[k] = train_metrics[k]
+                if guard is not None and retries:
+                    record["guard_retries"] = retries
+                retries = 0
+                if is_test:
+                    # eval reads the post-round model, so these dispatches
+                    # block on the round chain anyway — resolving now is free
+                    record.update(self.local_test_on_all_clients(round_idx))
+                    record.update(self.test_global(round_idx))
+                pending.append(record)
+                if guard is not None or is_test or is_ckpt:
+                    flush()
+                if is_ckpt:
+                    self.save_checkpoint(ckpt_dir, round_idx + 1)
+                round_idx += 1
+        finally:
+            prefetcher.close()
+        flush()
 
     # -- checkpoint state (utils.checkpoint.Checkpointable): global model +
     # aggregator state + history (SURVEY §5: the reference's core FedAvg
@@ -221,7 +398,7 @@ class FedAvgAPI(Checkpointable):
     def test_global(self, round_idx: int) -> dict[str, float]:
         bx, by, bm = self._test_batches
         m = self.eval_fn(self.global_variables, jnp.asarray(bx), jnp.asarray(by), jnp.asarray(bm))
-        m = {k: float(v) for k, v in m.items()}
+        m = {k: float(v) for k, v in jax.device_get(m).items()}
         total = max(m.get("test_total", 1.0), 1.0)
         return {
             "Test/Acc": m.get("test_correct", 0.0) / total,
@@ -249,7 +426,7 @@ class FedAvgAPI(Checkpointable):
             sums: dict[str, float] = {}
             if resident:
                 m = self._fed_eval_fn(self.global_variables, *resident[split_name])
-                sums = {k: float(v) for k, v in m.items()}
+                sums = {k: float(v) for k, v in jax.device_get(m).items()}
             else:
                 for start in range(0, num, chunk):
                     idx = np.arange(start, min(start + chunk, num))
@@ -259,8 +436,10 @@ class FedAvgAPI(Checkpointable):
                     m = self.client_eval_fn(
                         self.global_variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts)
                     )
-                    for k, v in m.items():
-                        sums[k] = sums.get(k, 0.0) + float(jnp.sum(v))
+                    # one fetch per chunk dispatch, then host-side sums —
+                    # the per-key float(jnp.sum(v)) did D2H per metric key
+                    for k, v in jax.device_get(m).items():
+                        sums[k] = sums.get(k, 0.0) + float(v.sum())
             total = max(sums.get("test_total", 0.0), 1.0)
             out[f"{split_name}/Acc"] = sums.get("test_correct", 0.0) / total
             out[f"{split_name}/Loss"] = sums.get("test_loss", 0.0) / total

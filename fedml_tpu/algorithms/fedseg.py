@@ -238,8 +238,10 @@ class FedSegAPI:
             self.history = list(self._inner.history)
             self._inner.history = []
         for r in range(start, cfg.comm_round):
+            # train_one_round already resolves the metrics dict to host
+            # floats in one device_get
             m = self._inner.train_one_round(r)
-            rec = {"round": r, **{k: float(v) for k, v in m.items()}}
+            rec = {"round": r, **m}
             if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
                 ev = self.evaluate()
                 rec.update({f"Test/{k}": v for k, v in ev.__dict__.items()})

@@ -155,7 +155,7 @@ class HierarchicalFLAPI:
         self.global_variables, metrics = self.round_fn(
             self.global_variables, self._x, self._y, self._counts, rng
         )
-        return {k: float(v) for k, v in metrics.items()}
+        return {k: float(v) for k, v in jax.device_get(metrics).items()}
 
     def train(self):
         history = []

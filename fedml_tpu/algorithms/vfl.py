@@ -102,14 +102,17 @@ class VerticalFederatedLearningAPI:
         return self
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        # accumulate the per-party logit contributions on device and fetch
+        # ONCE after the loop — np.asarray/float per party was one blocking
+        # transfer per participant
         xs = self._slice(X)
-        u = np.zeros(len(X), np.float32)
+        u = jnp.zeros(len(X), jnp.float32)
         for k, p in enumerate(self.params):
-            comp = np.asarray(xs[k] @ p["w"][:, 0])
+            comp = xs[k] @ p["w"][:, 0]
             if "b" in p:
-                comp = comp + float(p["b"][0])
-            u += comp
-        return 1.0 / (1.0 + np.exp(-u))
+                comp = comp + p["b"][0]
+            u = u + comp
+        return 1.0 / (1.0 + np.exp(-np.asarray(u)))
 
     def score(self, X, y) -> float:
         return float(np.mean((self.predict_proba(X) > 0.5).astype(int) == y))

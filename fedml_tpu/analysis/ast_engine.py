@@ -25,6 +25,15 @@ itself the `bare-suppression` finding):
 - `bare-suppression`: a `# graft-lint: disable=<rule>` comment without a
   `-- <reason>` tail — every suppression must say WHY the rule is wrong
   here, or the next reader deletes the comment and reintroduces the bug.
+- `blocking-fetch-in-drive-loop` (algorithms/ drivers only): per-item
+  `float()`/`int()`/`np.asarray()`/`.item()` host syncs inside `for`/
+  comprehension iteration, or `float(jnp...)` anywhere inside a loop — the
+  UNTRACED drive-loop half of the host-sync story (the jaxpr host-sync rule
+  only sees traced code). Each such call is one blocking device round trip
+  per item through the driver tunnel; the blessed idiom is ONE
+  `jax.device_get` of the whole tree with host-side iteration —
+  `{k: float(v) for k, v in jax.device_get(m).items()}` is clean because
+  the iterable resolves everything in a single transfer.
 """
 
 from __future__ import annotations
@@ -241,6 +250,143 @@ class _SyncIdiom(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _DriveLoopFetch(ast.NodeVisitor):
+    """blocking-fetch-in-drive-loop: per-item host syncs in the untraced
+    drive loops of algorithms/ drivers.
+
+    Two triggers, one rule:
+    - a `float()`/`int()`/`np.asarray()`/`np.array()`/`.item()` whose
+      argument mentions the target variable of an enclosing `for` statement
+      or comprehension generator — the per-item fetch shape
+      (`{k: float(v) for k, v in metrics.items()}` syncs once per key);
+    - any `float(jnp...)`/`int(jnp...)`/`np.asarray(jnp...)` inside a loop
+      (for/while/comprehension) — a device value resolved per iteration
+      regardless of what drives the loop.
+
+    A loop/generator whose iterable expression contains a `device_get` call
+    blesses its targets: the transfer already happened in one batch, so
+    host-side `float()` over the fetched tree is free. Shape/size
+    arithmetic (`int(np.prod(l.shape))` and friends) never touches device
+    data and is skipped.
+    """
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self._frames: List[tuple] = []  # (target_names, blessed)
+        self._loops = 0
+
+    @staticmethod
+    def _names(node) -> Set[str]:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    @staticmethod
+    def _blessed(iter_node) -> bool:
+        for sub in ast.walk(iter_node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name and name.split(".")[-1] == "device_get":
+                    return True
+        return False
+
+    @staticmethod
+    def _shape_math(expr) -> bool:
+        # int(np.prod(l.shape[1:])) etc. — static metadata, no device data
+        return any(isinstance(sub, ast.Attribute)
+                   and sub.attr in {"shape", "ndim", "size", "nbytes"}
+                   for sub in ast.walk(expr))
+
+    @staticmethod
+    def _has_jnp_call(expr) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name.startswith("jnp.") or name.startswith("jax.numpy."):
+                    return True
+        return False
+
+    def _emit(self, node, what: str):
+        if not is_suppressed(self.lines, node.lineno,
+                             "blocking-fetch-in-drive-loop"):
+            self.findings.append(Finding(
+                "blocking-fetch-in-drive-loop", f"{self.path}:{node.lineno}",
+                f"{what} inside a drive loop is one blocking device->host "
+                "round trip per item; fetch once with jax.device_get(tree) "
+                "and iterate the host copy"))
+
+    # ---- loop frames ------------------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.visit(node.iter)  # the iterable belongs to the OUTER scope
+        self._frames.append((self._names(node.target),
+                             self._blessed(node.iter)))
+        self._loops += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._loops -= 1
+        self._frames.pop()
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While):
+        self.visit(node.test)
+        self._loops += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._loops -= 1
+
+    def _visit_comprehension(self, node, bodies):
+        for gen in node.generators:
+            self.visit(gen.iter)
+        for gen in node.generators:
+            self._frames.append((self._names(gen.target),
+                                 self._blessed(gen.iter)))
+        self._loops += 1
+        for body in bodies:
+            self.visit(body)
+        for gen in node.generators:
+            for cond in gen.ifs:
+                self.visit(cond)
+        self._loops -= 1
+        for _ in node.generators:
+            self._frames.pop()
+
+    def visit_ListComp(self, node):
+        self._visit_comprehension(node, [node.elt])
+
+    visit_SetComp = visit_ListComp
+    visit_GeneratorExp = visit_ListComp
+
+    def visit_DictComp(self, node):
+        self._visit_comprehension(node, [node.key, node.value])
+
+    # ---- the fetch calls --------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        arg = None
+        what = None
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in {"float", "int"} and node.args):
+            arg, what = node.args[0], f"{node.func.id}()"
+        elif _is_np_asarray(node) and node.args:
+            arg, what = node.args[0], f"{_dotted(node.func)}()"
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            arg, what = node.func.value, ".item()"
+        if arg is not None and not self._shape_math(arg):
+            mentioned = self._names(arg)
+            per_item = any(targets & mentioned
+                           for targets, blessed in self._frames
+                           if not blessed)
+            in_any_blessed = any(targets & mentioned
+                                 for targets, blessed in self._frames
+                                 if blessed)
+            if per_item and not in_any_blessed:
+                self._emit(node, f"per-item {what}")
+            elif self._loops and self._has_jnp_call(arg):
+                self._emit(node, f"{what} on a jnp expression")
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -257,6 +403,11 @@ def lint_source(source: str, path: str) -> List[Finding]:
         if info.traced:
             _RuleRunner(info, path, lines, findings).visit(info.node)
     _SyncIdiom(path, lines, findings).visit(tree)
+    # drive-loop fetch hygiene is an algorithms/-driver contract: that is
+    # where the untraced round loops live (lint_tree hands us repo-relative
+    # paths, so the scope survives any checkout location)
+    if "algorithms" in path.replace(os.sep, "/").split("/"):
+        _DriveLoopFetch(path, lines, findings).visit(tree)
     for lineno, rules, reason in iter_suppressions(source):
         if reason is None and not is_suppressed(lines, lineno,
                                                 "bare-suppression"):

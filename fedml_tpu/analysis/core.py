@@ -17,6 +17,9 @@ RULES = {
     "host-transfer": "host sync (float/np.asarray/device_get/...) in traced code",
     "traced-loop": "Python for-loop over a traced array",
     "sync-idiom": "float(np.asarray(...)) double-transfer idiom",
+    "blocking-fetch-in-drive-loop": "per-item float()/np.asarray()/.item() "
+                                    "host sync inside an algorithms/ driver "
+                                    "round loop",
     "partition-coverage": "param tree leaf matches no PartitionSpec rule",
     # HLO-layer rules (hlo_engine / comms): lowered-program collectives
     "collective-in-loop": "loop-invariant collective inside a while/scan body",

@@ -82,6 +82,15 @@ class FedConfig:
     resident_eval: bool = True
     resident_eval_budget: int = 8 << 30
     backend: str = "vmap"  # vmap (single chip) | shard_map (mesh)
+    # >0 enables the asynchronous round pipeline in the FedAvg-family drive
+    # loop: a background stager gathers/faults/pads/device_puts cohort t+k
+    # (k <= pipeline_depth) while round t executes, staged buffers are
+    # DONATED into round_fn, and train metrics stay device-resident until a
+    # test/checkpoint round (or --guard) forces one jax.device_get.
+    # Bit-identical to the eager driver at any depth
+    # (tests/test_pipeline.py); 0 = eager legacy loop. The CLI default is 2
+    # (experiments/common.py); the library default stays eager.
+    pipeline_depth: int = 0
     # >0 enables the silo-grouped conv execution path (ResNetCifar models
     # only): convs with min(cin, cout) <= silo_threshold merge the round's
     # silos into one feature_group_count conv — measured 1.55x at 16-channel

@@ -1,10 +1,13 @@
 from fedml_tpu.data.packing import PackedClients, pack_client_data, pack_eval_batches
+from fedml_tpu.data.prefetch import CohortPrefetcher, StagedCohort
 from fedml_tpu.data.registry import FederatedDataset, load_dataset, register_loader
 
 __all__ = [
     "PackedClients",
     "pack_client_data",
     "pack_eval_batches",
+    "CohortPrefetcher",
+    "StagedCohort",
     "FederatedDataset",
     "load_dataset",
     "register_loader",

@@ -24,6 +24,7 @@ the algorithm APIs) decodes exactly one client.
 from __future__ import annotations
 
 import logging
+import threading
 from collections import OrderedDict
 from typing import Callable, Sequence
 
@@ -88,6 +89,11 @@ class StreamingPackedClients:
         self._cache: OrderedDict[int, np.ndarray] = OrderedDict()
         self._resident_bytes = 0
         self._sample_shape: tuple | None = None
+        # the cohort prefetcher (data/prefetch.py) calls select() from its
+        # staging thread while the drive loop may be evaluating on the main
+        # thread — the LRU OrderedDict + byte counter need one lock.
+        # Reentrant: select() pins rows through _client_row under the lock.
+        self._lock = threading.RLock()
         # labels are cheap — hold the padded [C, n_max] array eagerly
         self.y = np.zeros((len(self._files), self._n_max), np.int32)
         for k, lab in enumerate(client_labels):
@@ -113,12 +119,15 @@ class StreamingPackedClients:
     @property
     def sample_shape(self) -> tuple:
         if self._sample_shape is None:
-            for k, files in enumerate(self._files):
-                if files:
-                    self._sample_shape = tuple(self._decode(files[0]).shape)
-                    break
-            else:
-                raise ValueError("no files in any client")
+            with self._lock:
+                if self._sample_shape is None:
+                    for k, files in enumerate(self._files):
+                        if files:
+                            self._sample_shape = tuple(
+                                self._decode(files[0]).shape)
+                            break
+                    else:
+                        raise ValueError("no files in any client")
         return self._sample_shape
 
     def select(self, client_indices):
@@ -135,7 +144,9 @@ class StreamingPackedClients:
                 f"{self.byte_budget >> 20} MiB. Lower client_num_per_round / "
                 "image_size, cap samples per client (the ILSVRC2012 loader's "
                 "samples_per_client), or raise FEDML_TPU_STREAM_BUDGET.")
-        x = np.stack([self._client_row(int(k), pin=set(idx.tolist())) for k in idx])
+        with self._lock:
+            x = np.stack([self._client_row(int(k), pin=set(idx.tolist()))
+                          for k in idx])
         return x, self.y[idx], self.counts[idx]
 
     # ---- introspection (tests / ops) -------------------------------------
@@ -148,6 +159,10 @@ class StreamingPackedClients:
 
     # ---- internals --------------------------------------------------------
     def _client_row(self, k: int, pin: set | None = None) -> np.ndarray:
+        with self._lock:
+            return self._client_row_locked(k, pin)
+
+    def _client_row_locked(self, k: int, pin: set | None = None) -> np.ndarray:
         row = self._cache.get(k)
         if row is not None:
             self._cache.move_to_end(k)

@@ -64,6 +64,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "non-finite or spikes")
     parser.add_argument("--guard_spike_factor", type=float, default=4.0)
     parser.add_argument("--guard_max_retries", type=int, default=2)
+    # asynchronous round pipeline (fedml_tpu.data.prefetch): stage cohort
+    # t+k while round t executes + deferred metric sync; bit-identical to
+    # the eager loop at any depth, so it is on by default for CLI runs.
+    # 0 restores the eager driver.
+    parser.add_argument("--pipeline_depth", type=int, default=2,
+                        help="cohort prefetch depth for the FedAvg-family "
+                             "drive loop (0 = eager)")
     return parser
 
 

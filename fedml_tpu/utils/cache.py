@@ -5,16 +5,29 @@ from __future__ import annotations
 import os
 
 
-def enable_compile_cache(min_compile_secs: float = 1.0):
+def enable_compile_cache(min_compile_secs: float = 1.0,
+                         cache_dir: str | None = None) -> bool:
     """Point jax's persistent compilation cache at the repo-local .jax_cache
     (gitignored). Heavy compiles — the fused local-SGD pallas kernel (~30 min
     through the remote helper), DARTS/GDAS graphs — are paid once; every
-    later process (tests, CLIs, bench, the driver's bench run) reuses them."""
+    later process (tests, CLIs, bench, the driver's bench run) reuses them.
+
+    Wired on by default from experiments/common.setup_run and bench.py so
+    tunnel-path cold starts stop paying full retrace. Opt out with
+    FEDML_TPU_NO_COMPILE_CACHE=1 (e.g. when benchmarking cold-start compile
+    itself); FEDML_TPU_COMPILE_CACHE_DIR relocates the cache. Returns True
+    when the cache was enabled."""
+    if os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
+        return False
     import jax
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(repo_root, ".jax_cache"))
+    if cache_dir is None:
+        cache_dir = os.environ.get("FEDML_TPU_COMPILE_CACHE_DIR")
+    if cache_dir is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cache_dir = os.path.join(repo_root, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    return True

@@ -240,6 +240,72 @@ def test_ast_untraced_code_is_not_flagged():
     assert not [f for f in _findings(src) if f.rule == "host-transfer"]
 
 
+# -------------------------------------------- blocking-fetch-in-drive-loop
+
+def _drive_findings(src):
+    # the rule is path-scoped to algorithms/ driver modules
+    return [f for f in lint_source(src, "fedml_tpu/algorithms/fixture.py")
+            if f.rule == "blocking-fetch-in-drive-loop"]
+
+
+def test_drive_loop_fetch_fires_on_per_item_float():
+    # one blocking transfer per metric key — the eager-loop bug this PR fixes
+    src = (
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        m = self.round_fn(gv)\n"
+        "        rec = {k: float(v) for k, v in m.items()}\n")
+    findings = _drive_findings(src)
+    assert findings and "per-item float" in findings[0].message
+
+
+def test_drive_loop_fetch_fires_on_jnp_scalar_in_round_loop():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        loss = float(jnp.sum(v))\n")
+    assert _drive_findings(src)
+
+
+def test_drive_loop_fetch_blessed_device_get_is_clean():
+    # the fixed idiom: one bulk device_get, host-side floats afterwards
+    src = (
+        "import jax\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        m = self.round_fn(gv)\n"
+        "        rec = {k: float(v) for k, v in jax.device_get(m).items()}\n")
+    assert not _drive_findings(src)
+
+
+def test_drive_loop_fetch_shape_math_is_clean():
+    src = (
+        "import numpy as np\n"
+        "def sizes(tree):\n"
+        "    return [int(np.prod(l.shape[1:])) for l in tree]\n")
+    assert not _drive_findings(src)
+
+
+def test_drive_loop_fetch_scoped_to_algorithms_path():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        loss = float(jnp.sum(v))\n")
+    assert not [f for f in lint_source(src, "fedml_tpu/tools/fixture.py")
+                if f.rule == "blocking-fetch-in-drive-loop"]
+
+
+def test_drive_loop_fetch_suppression_works():
+    src = (
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        # graft-lint: disable=blocking-fetch-in-drive-loop -- field arithmetic on host ints\n"
+        "        rec = {k: float(v) for k, v in m.items()}\n")
+    assert not _drive_findings(src)
+
+
 # ------------------------------------------------------------ partition rules
 
 def test_partition_coverage_fires_on_unmatched_leaf():

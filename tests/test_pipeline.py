@@ -1,0 +1,208 @@
+"""Asynchronous round pipeline (ISSUE 5 tentpole): the pipelined drive loop
+must be BIT-identical to the eager loop at any depth — plain runs, chaos
+runs, guard rollbacks, and checkpoint resume — because staging is a pure
+function of round_idx and the round rng stream is untouched. Plus the
+prefetcher's contract with streaming stores: only sampled clients decode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.prefetch import CohortPrefetcher
+from fedml_tpu.data.registry import FederatedDataset, load_dataset
+from fedml_tpu.data.streaming import StreamingPackedClients
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.robustness.guard import GuardVerdict
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _cfg(comm_round, **kw):
+    kw.setdefault("client_num_per_round", 8)
+    return FedConfig(dataset="mnist", model="lr", comm_round=comm_round,
+                     batch_size=8, lr=0.05, client_num_in_total=8,
+                     seed=0, **kw)
+
+
+def _api(ds, cfg, aggregator_name="fedavg"):
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    return FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
+
+
+def _bitwise_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+def _strip_times(history):
+    return [{k: v for k, v in r.items() if k != "round_time"}
+            for r in history]
+
+
+# ------------------------------------------------------------- bit identity
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+@pytest.mark.parametrize("agg_name,cfg_extra", [
+    ("fedavg", {}),
+    ("fedopt", {"server_optimizer": "adam", "server_lr": 0.01}),
+])
+def test_pipelined_bit_identical_to_eager(ds8, depth, agg_name, cfg_extra):
+    eager = _api(ds8, _cfg(5, **cfg_extra), agg_name)
+    eager.train()
+    piped = _api(ds8, _cfg(5, pipeline_depth=depth, **cfg_extra), agg_name)
+    piped.train()
+    assert _bitwise_equal(piped.global_variables, eager.global_variables)
+    assert _bitwise_equal(piped.agg_state, eager.agg_state)
+    assert _strip_times(piped.history) == _strip_times(eager.history)
+
+
+def test_pipelined_chaos_bit_identical(ds8):
+    """FaultPlan.events is pure in (seed, round_idx), so the staging thread
+    reproduces the eager loop's fault schedule byte-for-byte."""
+    plan = lambda: FaultPlan(seed=3, drop_rate=0.25, nan_rate=0.25)
+    eager = _api(ds8, _cfg(5))
+    eager.train(chaos=plan())
+    piped = _api(ds8, _cfg(5, pipeline_depth=2))
+    piped.train(chaos=plan())
+    assert _bitwise_equal(piped.global_variables, eager.global_variables)
+    assert _strip_times(piped.history) == _strip_times(eager.history)
+
+
+class _RejectOnce:
+    """Deterministic guard: rejects exactly one round once, accepts after."""
+
+    max_retries = 2
+
+    def __init__(self, bad_round=2):
+        self.bad_round = bad_round
+        self.fired = False
+
+    def inspect(self, round_idx, loss, global_variables=None):
+        if round_idx == self.bad_round and not self.fired:
+            self.fired = True
+            return GuardVerdict(False, "forced test rejection")
+        return GuardVerdict(True, "")
+
+
+def test_guard_rollback_drops_stale_cohorts(ds8):
+    """A rejected round invalidates every in-flight prefetch: the retried
+    round re-stages from scratch (round 2 staged twice) and the driver's
+    round_idx assertion proves no stale cohort was consumed. Outcome stays
+    bit-identical to the eager loop under the same guard."""
+    eager = _api(ds8, _cfg(5))
+    eager.train(guard=_RejectOnce(bad_round=2))
+    piped = _api(ds8, _cfg(5, pipeline_depth=2))
+    piped.train(guard=_RejectOnce(bad_round=2))
+
+    pf = piped._last_prefetcher
+    assert pf.staged_rounds.count(2) == 2      # invalidated, then re-staged
+    assert pf.consumed_rounds.count(2) == 2    # consumed once per attempt
+    assert [r["round"] for r in piped.history] == list(range(5))
+    assert piped.history[2].get("guard_retries") == 1
+    assert _bitwise_equal(piped.global_variables, eager.global_variables)
+    assert _strip_times(piped.history) == _strip_times(eager.history)
+
+
+def test_pipelined_checkpoint_resume_bit_identical(ds8, tmp_path):
+    """Interrupt at round 3, resume with a NEW pipelined API: final state
+    matches the straight pipelined run AND the straight eager run."""
+    straight = _api(ds8, _cfg(6))
+    straight.train()
+
+    d = str(tmp_path / "ckpt_pipe")
+    first = _api(ds8, _cfg(3, pipeline_depth=2))
+    first.train(ckpt_dir=d, ckpt_every=100)
+    resumed = _api(ds8, _cfg(6, pipeline_depth=2))
+    hist = resumed.train(ckpt_dir=d, ckpt_every=100)
+
+    assert _bitwise_equal(resumed.global_variables, straight.global_variables)
+    assert _bitwise_equal(resumed.agg_state, straight.agg_state)
+    assert len(hist) == 6
+
+
+# ------------------------------------------------- streaming store contract
+
+def _counting_streaming_ds(clients=8, per_client=6, dim=12, class_num=2):
+    """StreamingPackedClients over synthetic 'files' (decode_fn is pure in
+    the path string — no disk), with a decode-call log."""
+    decoded: list[int] = []
+
+    def dec(path):
+        k, i = (int(s) for s in path.split("_")[1:])
+        decoded.append(k)
+        rs = np.random.RandomState(k * 1000 + i)
+        return rs.rand(dim).astype(np.float32)
+
+    files = [[f"f_{k}_{i}" for i in range(per_client)]
+             for k in range(clients)]
+    labels = [np.arange(per_client) % class_num for _ in range(clients)]
+    row_bytes = per_client * dim * 4
+    st = StreamingPackedClients(files, labels, dec,
+                                byte_budget=4 * row_bytes)
+    rs = np.random.RandomState(99)
+    gx = rs.rand(16, dim).astype(np.float32)
+    gy = (np.arange(16) % class_num).astype(np.int32)
+    ds = FederatedDataset(name="synth-stream", train=st, test=None,
+                          train_global=(gx, gy), test_global=(gx, gy),
+                          class_num=class_num, meta={"streaming": True})
+    return ds, decoded
+
+
+def test_prefetch_decodes_only_sampled_clients():
+    """The staging thread must touch exactly the sampled cohorts' rows —
+    ci=1 confines eval to client 0 — and the LRU byte budget holds even
+    with the prefetcher running ahead."""
+    ds, decoded = _counting_streaming_ds()
+    cfg = _cfg(4, client_num_per_round=3, pipeline_depth=2, ci=1,
+               frequency_of_the_test=100)
+    api = _api(ds, cfg)
+    api.train()
+
+    sampled = set()
+    for r in range(cfg.comm_round):
+        sampled.update(client_sampling(r, ds.client_num,
+                                       cfg.client_num_per_round).tolist())
+    assert set(decoded) <= sampled | {0}   # client 0: example input + ci eval
+    assert ds.train.resident_bytes <= ds.train.byte_budget
+
+    eager_ds, _ = _counting_streaming_ds()
+    eager = _api(eager_ds, _cfg(4, client_num_per_round=3, ci=1,
+                                frequency_of_the_test=100))
+    eager.train()
+    assert _bitwise_equal(api.global_variables, eager.global_variables)
+
+
+# ------------------------------------------------------- prefetcher surface
+
+def test_prefetcher_miss_restages_and_counts():
+    staged = []
+
+    def stage(r):
+        staged.append(r)
+        return type("C", (), {"round_idx": r})()
+
+    with CohortPrefetcher(stage, depth=2) as pf:
+        assert pf.prefetch(0)
+        assert pf.prefetch(1)
+        assert not pf.prefetch(2)         # at depth: dropped
+        assert pf.get(0).round_idx == 0
+        assert pf.get(5).round_idx == 5   # never staged -> on-demand miss
+        assert pf.misses == 1
+        pf.prefetch(6)
+        pf.invalidate()                   # forgets 6 (run or not)
+        assert pf.get(6).round_idx == 6   # -> miss, fresh staging
+        assert pf.misses == 2
+    assert 2 not in staged
+    assert staged.count(6) in (1, 2)      # 2 iff the job beat the cancel

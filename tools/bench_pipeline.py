@@ -1,0 +1,166 @@
+"""A/B the FedAvg drive loop: eager vs asynchronous round pipeline.
+
+Measures FULL `FedAvgAPI.train()` wall-clock — sampling, gather, H2D,
+dispatch, metric resolution — not just `round_fn`, because the pipeline's
+whole point is hiding the host half of the round behind device compute
+(docs/PERF.md r10). Workload is the FEMNIST north-star surrogate (3400
+clients, 10/round, CNN_DropOut shapes, bs 20, E=1 — BASELINE.md) with
+FEMNIST-shaped synthetic data; the trajectory is bit-identical across arms
+(tests/test_pipeline.py), so only wall-clock differs.
+
+Env knobs:
+  BENCH_PIPE_CLIENTS=3400        federation size
+  BENCH_PIPE_CLIENTS_PER_ROUND=10
+  BENCH_PIPE_SAMPLES_PER_CLIENT=200
+  BENCH_PIPE_MODEL=cnn           any models.registry name (lr for a
+                                 dispatch-bound lower bound)
+  BENCH_PIPE_BATCH=20  BENCH_PIPE_ROUNDS=20  BENCH_PIPE_REPS=3
+  BENCH_PIPE_DEPTHS=0,2          comma list; 0 = eager baseline arm
+  BENCH_PIPE_STREAMING=0         1: StreamingPackedClients with a synthetic
+                                 per-image decode — the regime where staging
+                                 is real host work (FEMNIST png decode) and
+                                 the overlap win is largest
+  BENCH_PIPE_OUT=BENCH_r06.json  '' to skip writing the artifact
+
+Prints one JSON line; writes the BENCH_rXX-style artifact next to the repo
+root. On hosts without spare cores (nproc=1 CI boxes) staging and compute
+serialize on the same core, so the speedup honestly reads ~1.0x there —
+the JSON carries cpu_cores/cpu_capped so readers can tell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.utils.cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+import jax  # noqa: E402
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI  # noqa: E402
+from fedml_tpu.core.config import FedConfig  # noqa: E402
+from fedml_tpu.core.trainer import ClassificationTrainer  # noqa: E402
+from fedml_tpu.data.packing import PackedClients  # noqa: E402
+from fedml_tpu.data.registry import FederatedDataset  # noqa: E402
+from fedml_tpu.models.registry import create_model  # noqa: E402
+
+SHAPE, CLASSES = (28, 28, 1), 62  # FEMNIST geometry
+
+
+def _surrogate(clients: int, per_client: int, streaming: bool):
+    """FEMNIST-shaped synthetic federation. Packed mode broadcasts one
+    client's pixels across the federation (zero-copy view — select() still
+    performs the real per-round gather memcpy); streaming mode decodes
+    per-image on demand, modelling the png-decode staging cost."""
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, CLASSES, size=(clients, per_client)).astype(np.int32)
+    counts = np.full(clients, per_client, np.int64)
+    gx = rng.rand(64, *SHAPE).astype(np.float32)
+    gy = rng.randint(0, CLASSES, size=64).astype(np.int32)
+    if streaming:
+        from fedml_tpu.data.streaming import StreamingPackedClients
+
+        def dec(path):  # ~one png decode's worth of host work per image
+            k, i = (int(s) for s in path.split("_")[1:])
+            rs = np.random.RandomState(k * per_client + i)
+            return rs.rand(*SHAPE).astype(np.float32)
+
+        files = [[f"f_{k}_{i}" for i in range(per_client)]
+                 for k in range(clients)]
+        train = StreamingPackedClients(files, list(y), dec,
+                                       byte_budget=4 << 30)
+    else:
+        row = rng.rand(1, per_client, *SHAPE).astype(np.float32)
+        x = np.broadcast_to(row, (clients, per_client) + SHAPE)
+        train = PackedClients(x, y, counts)
+    return FederatedDataset(name="femnist_surrogate", train=train, test=None,
+                            train_global=(gx, gy), test_global=(gx, gy),
+                            class_num=CLASSES, meta={})
+
+
+def _run_arm(ds, depth: int, model: str, batch: int, rounds: int,
+             cpr: int, reps: int) -> tuple[float, list[float]]:
+    cfg = FedConfig(dataset="femnist_surrogate", model=model,
+                    comm_round=rounds, batch_size=batch, epochs=1, lr=0.1,
+                    client_num_in_total=ds.client_num,
+                    client_num_per_round=cpr, seed=0, ci=1,
+                    frequency_of_the_test=10**9, pipeline_depth=depth)
+    trainer = ClassificationTrainer(create_model(model, output_dim=CLASSES))
+    api = FedAvgAPI(ds, cfg, trainer)
+    api.train()  # compile + warm (persistent cache makes this cheap)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        api.train()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), times
+
+
+def main():
+    clients = int(os.environ.get("BENCH_PIPE_CLIENTS", 3400))
+    cpr = int(os.environ.get("BENCH_PIPE_CLIENTS_PER_ROUND", 10))
+    per_client = int(os.environ.get("BENCH_PIPE_SAMPLES_PER_CLIENT", 200))
+    model = os.environ.get("BENCH_PIPE_MODEL", "cnn")
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", 20))
+    rounds = int(os.environ.get("BENCH_PIPE_ROUNDS", 20))
+    reps = max(1, int(os.environ.get("BENCH_PIPE_REPS", 3)))
+    depths = [int(d) for d in
+              os.environ.get("BENCH_PIPE_DEPTHS", "0,2").split(",")]
+    streaming = os.environ.get("BENCH_PIPE_STREAMING", "0") == "1"
+    if 0 not in depths:
+        depths = [0] + depths
+
+    arms = {}
+    for depth in depths:
+        # streaming stores carry LRU state — fresh store per arm
+        ds = _surrogate(clients, per_client, streaming)
+        med, times = _run_arm(ds, depth, model, batch, rounds, cpr, reps)
+        arms[depth] = {"rounds_per_sec": round(rounds / med, 4),
+                       "spread": {"min": round(rounds / max(times), 4),
+                                  "max": round(rounds / min(times), 4),
+                                  "reps": reps}}
+    eager = arms[0]["rounds_per_sec"]
+    best_depth = max((d for d in arms if d), default=0,
+                     key=lambda d: arms[d]["rounds_per_sec"])
+    speedup = arms[best_depth]["rounds_per_sec"] / eager if best_depth else 1.0
+    cores = os.cpu_count() or 1
+    result = {
+        "metric": "fedavg_drive_loop_pipeline_speedup",
+        "value": round(speedup, 4),
+        "unit": "x (pipelined rounds/s over eager, full drive loop)",
+        "vs_baseline": None,
+        "best_depth": best_depth,
+        "arms": {str(d): v for d, v in arms.items()},
+        "clients": clients, "clients_per_round": cpr,
+        "samples_per_client": per_client, "model": model,
+        "batch_size": batch, "rounds": rounds, "streaming": streaming,
+        "platform": jax.devices()[0].platform,
+        "cpu_cores": cores,
+        # one core => the staging thread and device compute serialize; the
+        # overlap this pipeline buys needs a spare host core (or a TPU,
+        # where compute never touches the host cores at all)
+        "cpu_capped": jax.devices()[0].platform == "cpu" and cores < 2,
+    }
+    line = json.dumps(result)
+    print(line)
+
+    out = os.environ.get("BENCH_PIPE_OUT", "BENCH_r06.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": reps, "cmd": "python tools/bench_pipeline.py",
+                       "rc": 0, "tail": line + "\n", "parsed": result},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
