@@ -93,6 +93,57 @@ assert_summary "participated_count" 1 7
 assert_summary "Test/Loss" 0 10
 assert_summary "Test/Acc" 0.0 1.0
 
+echo "== graft-trace smoke (depth-2 chaos drive: --trace_summary + span coverage)"
+# same chaos workload, pipelined, with the tracer's p50/p95 table on stdout;
+# TRACE.jsonl lands next to the run files and must cover >=95% of round
+# wall-clock with phase spans and carry the chaos/commit event ledger
+rm -rf /tmp/ci_smoke_trace_ckpt   # a stale ckpt would resume past the rounds
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 2 \
+  --epochs 1 --batch_size 4 --pipeline_depth 2 \
+  --chaos 1 --chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 --guard 1 \
+  --ckpt_dir /tmp/ci_smoke_trace_ckpt \
+  --trace_summary 1 | tee /tmp/ci_smoke_trace_stdout.txt
+grep -Eq '^phase +count +total_s +p50_ms +p95_ms' /tmp/ci_smoke_trace_stdout.txt
+grep -Eq '^dispatch ' /tmp/ci_smoke_trace_stdout.txt
+python - "$RUN_DIR" <<'EOF'
+import sys
+from fedml_tpu.telemetry.report import fold, load_trace
+report = fold(load_trace(f"{sys.argv[1]}/TRACE.jsonl"))
+assert report["coverage"] >= 0.95, f"span coverage {report['coverage']} < 0.95"
+assert report["rounds"] == 2, report["rounds"]
+ev = report["events"]
+assert ev.get("chaos_inject", 0) >= 2, ev
+assert ev.get("guard_verdict", 0) >= 2, ev
+assert ev.get("round_committed", 0) == 2, ev
+assert ev.get("checkpoint_save", 0) >= 1, ev
+print(f"OK trace: coverage={report['coverage']} events={ev}")
+EOF
+
+echo "== perf-regression gate (ROADMAP item 5): TRACE rounds/s vs BENCH baseline"
+rm -f /tmp/ci_gate_trace.jsonl
+BENCH_PIPE_ROUNDS=10 BENCH_PIPE_REPS=2 BENCH_PIPE_DEPTHS=0 BENCH_PIPE_MODEL=lr \
+  BENCH_PIPE_OUT='' BENCH_PIPE_TRACE=/tmp/ci_gate_trace.jsonl \
+  python tools/bench_pipeline.py
+python tools/trace_report.py /tmp/ci_gate_trace.jsonl --gate \
+  | tee /tmp/ci_gate_out.txt
+
+if grep -q 'perf-regression gate: PASS' /tmp/ci_gate_out.txt; then
+  echo "== perf gate self-test: a 20x throttle must trip it (exit 1)"
+  if python tools/trace_report.py /tmp/ci_gate_trace.jsonl --gate \
+       --self-test-throttle 0.05 >/tmp/ci_gate_trip.txt 2>&1; then
+    echo "perf gate FAILED TO TRIP on a 20x artificial throttle:"
+    cat /tmp/ci_gate_trip.txt
+    exit 1
+  fi
+  grep -q 'perf-regression gate: FAIL' /tmp/ci_gate_trip.txt
+  echo "OK perf gate trips on artificial throttle"
+else
+  # gate SKIPped (baseline from an incomparable box) — the trip self-test
+  # would skip identically, so there is nothing to prove here
+  echo "perf gate self-test: skipped (gate did not run against this baseline)"
+fi
+
 echo "== fedavg equivalence oracle: full-batch E=1 FedAvg == centralized"
 python - <<'EOF'
 # the reference CI's key trick (CI-script-fedavg.sh:44-50) as a direct check

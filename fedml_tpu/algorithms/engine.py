@@ -373,6 +373,13 @@ def build_round_fn_from_update(batched_update, aggregator,
         metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
         return new_global, new_state, metrics
 
+    # ledger breadcrumb for multi-program debugging (async aggregation /
+    # multi-tenant scheduling build many round programs per process); no-op
+    # without an installed tracer, and never inside the traced function
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="engine.round",
+                   donate=donate_data)
+
     if not donate_data:
         return jax.jit(round_fn)
 

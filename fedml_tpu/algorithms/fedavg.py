@@ -10,7 +10,7 @@ the device mesh (fedml_tpu.parallel) is the "cluster".
 from __future__ import annotations
 
 import logging
-import time
+import os
 from collections import deque
 from typing import Any
 
@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu import telemetry
 from fedml_tpu.algorithms.aggregators import make_aggregator
 from fedml_tpu.algorithms.engine import (
     build_client_eval_fn,
@@ -30,15 +31,10 @@ from fedml_tpu.data.packing import pack_eval_batches, pad_clients
 from fedml_tpu.data.prefetch import CohortPrefetcher, StagedCohort
 from fedml_tpu.data.registry import FederatedDataset
 from fedml_tpu.robustness.chaos import apply_faults, summarize as chaos_summary
+from fedml_tpu.telemetry.records import RoundRecordLog, _scalar  # noqa: F401
 from fedml_tpu.utils.checkpoint import Checkpointable
 
 log = logging.getLogger(__name__)
-
-
-def _scalar(v):
-    """Host scalar from an already-fetched record value (numpy after
-    jax.device_get); host ints/floats/strings pass through."""
-    return float(v) if hasattr(v, "dtype") else v
 
 
 def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
@@ -119,42 +115,51 @@ class FedAvgAPI(Checkpointable):
 
     # ------------------------------------------------------------------ train
     def train_one_round(self, round_idx: int, faults=None,
-                        rng_salt: int = 0) -> dict[str, Any]:
+                        rng_salt: int = 0, tracer=None) -> dict[str, Any]:
         """One synchronous round. `faults` (robustness.chaos.FaultEvents for
         this round's cohort) injects drops/NaN/corruption at the host
         boundary and arms the in-round participation mask + quarantine;
         `rng_salt` != 0 derives a fresh round rng (guard retries — salt 0
-        keeps the legacy stream bit-exactly)."""
+        keeps the legacy stream bit-exactly). Phase spans (stage/h2d/
+        dispatch/metrics_fetch) bracket — never enter — the jitted call, so
+        an installed tracer changes no lowered program."""
         cfg = self.cfg
-        idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
-        x, y, counts = self.dataset.train.select(idx)
-        participation = None
-        if faults is not None:
-            x = apply_faults(faults, x)
-            participation = np.asarray(faults.participation, bool)
-        if self.mesh is not None:
-            n_before = counts.shape[0]
-            x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
-            if participation is not None and counts.shape[0] > n_before:
-                # padded rows are zero-count no-ops either way; marking them
-                # non-participating keeps participated_count honest
-                participation = np.concatenate(
-                    [participation,
-                     np.zeros(counts.shape[0] - n_before, bool)])
-        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
-        if rng_salt:
-            rng = jax.random.fold_in(rng, rng_salt)
-        args = [self.global_variables, self.agg_state, jnp.asarray(x),
-                jnp.asarray(y), jnp.asarray(counts), rng]
-        if participation is not None:
-            args.append(jnp.asarray(participation))
-        self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
-        # ONE host round trip for the whole metrics dict — per-key float()
-        # was one blocking transfer per metric through the driver tunnel
-        return {k: float(v) for k, v in jax.device_get(train_metrics).items()}
+        if tracer is None:
+            tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
+        with tracer.span("stage", round_idx):
+            idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
+            x, y, counts = self.dataset.train.select(idx)
+            participation = None
+            if faults is not None:
+                x = apply_faults(faults, x)
+                participation = np.asarray(faults.participation, bool)
+            if self.mesh is not None:
+                n_before = counts.shape[0]
+                x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
+                if participation is not None and counts.shape[0] > n_before:
+                    # padded rows are zero-count no-ops either way; marking them
+                    # non-participating keeps participated_count honest
+                    participation = np.concatenate(
+                        [participation,
+                         np.zeros(counts.shape[0] - n_before, bool)])
+        with tracer.span("h2d", round_idx):
+            rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
+            if rng_salt:
+                rng = jax.random.fold_in(rng, rng_salt)
+            args = [self.global_variables, self.agg_state, jnp.asarray(x),
+                    jnp.asarray(y), jnp.asarray(counts), rng]
+            if participation is not None:
+                args.append(jnp.asarray(participation))
+        with tracer.span("dispatch", round_idx):
+            self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
+        with tracer.span("metrics_fetch", round_idx):
+            # ONE host round trip for the whole metrics dict — per-key float()
+            # was one blocking transfer per metric through the driver tunnel
+            return {k: float(v) for k, v in jax.device_get(train_metrics).items()}
 
     def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
-              metrics_logger=None, chaos=None, guard=None) -> list[dict[str, Any]]:
+              metrics_logger=None, chaos=None, guard=None,
+              tracer=None) -> list[dict[str, Any]]:
         """Drive loop. `chaos` (robustness.chaos.FaultPlan) injects a seeded
         deterministic fault schedule per round; `guard`
         (robustness.guard.RoundGuard) inspects every round and, on a bad
@@ -167,74 +172,108 @@ class FedAvgAPI(Checkpointable):
         (`_train_pipelined`): cohort t+k staged by a background thread while
         round t executes, staged buffers donated into `round_fn`, metrics
         resolved in one deferred `jax.device_get`. Bit-identical to the
-        eager loop at any depth — tests/test_pipeline.py."""
+        eager loop at any depth — tests/test_pipeline.py.
+
+        `tracer` (telemetry.Tracer) records per-round phase spans and the
+        structured event ledger; when None, a default tracer is created
+        (with a TRACE.jsonl manifest next to the checkpoints when
+        `ckpt_dir` is given) and closed at the end of the drive. The
+        tracer is installed as the module-level telemetry seam for the
+        duration, so the chaos harness, guard, prefetcher, and compile
+        cache emit into the same ledger — including from the background
+        staging thread."""
         cfg = self.cfg
+        owns_tracer = tracer is None
+        if tracer is None:
+            tracer = telemetry.Tracer(
+                jsonl_path=os.path.join(ckpt_dir, "TRACE.jsonl")
+                if ckpt_dir else None)
+        self._last_tracer = tracer  # test/ops introspection
         start_round = 0
         if ckpt_dir:
             start_round = self.maybe_restore(ckpt_dir)
-        if cfg.pipeline_depth > 0:
-            self._train_pipelined(start_round, ckpt_dir, ckpt_every,
-                                  metrics_logger, chaos, guard)
-        else:
-            self._train_eager(start_round, ckpt_dir, ckpt_every,
-                              metrics_logger, chaos, guard)
-        if ckpt_dir:
-            self.save_checkpoint(ckpt_dir, cfg.comm_round)
+        telemetry.install(tracer)
+        try:
+            with tracer.span("drive"):
+                if cfg.pipeline_depth > 0:
+                    self._train_pipelined(start_round, ckpt_dir, ckpt_every,
+                                          metrics_logger, chaos, guard, tracer)
+                else:
+                    self._train_eager(start_round, ckpt_dir, ckpt_every,
+                                      metrics_logger, chaos, guard, tracer)
+                if ckpt_dir:
+                    with tracer.span("checkpoint"):
+                        self.save_checkpoint(ckpt_dir, cfg.comm_round)
+        finally:
+            telemetry.uninstall(tracer)
+            if owns_tracer:
+                tracer.close()
         return self.history
 
     def _train_eager(self, start_round, ckpt_dir, ckpt_every, metrics_logger,
-                     chaos, guard) -> None:
+                     chaos, guard, tracer) -> None:
         """Legacy synchronous drive loop: stage, dispatch, block, resolve —
-        every phase serialized against the device."""
+        every phase serialized against the device. Records commit through
+        the same `RoundRecordLog` path as the pipelined loop (one code path
+        for history/metrics/ledger), flushed every round."""
         cfg = self.cfg
+        records = RoundRecordLog(tracer, self.history, metrics_logger)
         round_idx = start_round
         retries = 0
         while round_idx < cfg.comm_round:
-            t0 = time.time()
-            faults = None
-            if chaos is not None:
-                n_cohort = min(cfg.client_num_per_round, self.dataset.client_num)
-                faults = chaos.events(round_idx, n_cohort)
-            snapshot = None
-            if guard is not None:
-                # jax pytrees are immutable: holding the refs IS the snapshot
-                snapshot = (self._ckpt_tree(), self._ckpt_meta())
-            train_metrics = self.train_one_round(round_idx, faults=faults,
-                                                 rng_salt=retries)
-            jax.block_until_ready(self.global_variables)
-            if guard is not None:
-                total = max(train_metrics.get("total", 1.0), 1.0)
-                loss = train_metrics.get("loss_sum", 0.0) / total
-                verdict = guard.inspect(round_idx, loss, self.global_variables)
-                if not verdict.ok and retries < guard.max_retries:
-                    retries += 1
-                    log.warning("guard: %s — rolled back, retrying with "
-                                "fresh rng (%d/%d)", verdict.reason, retries,
-                                guard.max_retries)
-                    self._ckpt_load(*snapshot)
-                    continue
-                if not verdict.ok:
-                    log.warning("guard: %s — retries exhausted, accepting "
-                                "the round", verdict.reason)
-            record = {"round": round_idx, "round_time": time.time() - t0}
-            if faults is not None:
-                record.update(chaos_summary(faults))
-                for k in ("participated_count", "quarantined_count"):
-                    if k in train_metrics:
-                        record[k] = train_metrics[k]
-            if guard is not None and retries:
-                record["guard_retries"] = retries
-            retries = 0
-            if round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1:
-                record.update(self.local_test_on_all_clients(round_idx))
-                record.update(self.test_global(round_idx))
-            self.history.append(record)
-            if metrics_logger is not None:
-                metrics_logger.log({k: v for k, v in record.items() if k != "round"},
-                                   step=round_idx)
-            if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
-                self.save_checkpoint(ckpt_dir, round_idx + 1)
-            log.info("round %d: %s (train %s)", round_idx, {k: v for k, v in record.items() if k != "round"}, train_metrics)
+            with tracer.round(round_idx) as rspan:
+                faults = None
+                if chaos is not None:
+                    n_cohort = min(cfg.client_num_per_round, self.dataset.client_num)
+                    faults = chaos.events(round_idx, n_cohort)
+                snapshot = None
+                if guard is not None:
+                    # jax pytrees are immutable: holding the refs IS the snapshot
+                    snapshot = (self._ckpt_tree(), self._ckpt_meta())
+                train_metrics = self.train_one_round(round_idx, faults=faults,
+                                                     rng_salt=retries,
+                                                     tracer=tracer)
+                with tracer.span("device_wait", round_idx):
+                    jax.block_until_ready(self.global_variables)
+                if guard is not None:
+                    total = max(train_metrics.get("total", 1.0), 1.0)
+                    loss = train_metrics.get("loss_sum", 0.0) / total
+                    with tracer.span("guard_verdict", round_idx):
+                        verdict = guard.inspect(round_idx, loss,
+                                                self.global_variables)
+                    tracer.event("guard_verdict", round=round_idx,
+                                 ok=verdict.ok, reason=verdict.reason)
+                    if not verdict.ok and retries < guard.max_retries:
+                        retries += 1
+                        log.warning("guard: %s — rolled back, retrying with "
+                                    "fresh rng (%d/%d)", verdict.reason, retries,
+                                    guard.max_retries)
+                        tracer.event("guard_rollback", round=round_idx,
+                                     retry=retries)
+                        self._ckpt_load(*snapshot)
+                        continue
+                    if not verdict.ok:
+                        log.warning("guard: %s — retries exhausted, accepting "
+                                    "the round", verdict.reason)
+                        tracer.event("guard_exhausted", round=round_idx)
+                record = {"round": round_idx, "round_time": rspan.elapsed()}
+                if faults is not None:
+                    record.update(chaos_summary(faults))
+                    for k in ("participated_count", "quarantined_count"):
+                        if k in train_metrics:
+                            record[k] = train_metrics[k]
+                if guard is not None and retries:
+                    record["guard_retries"] = retries
+                retries = 0
+                if round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1:
+                    with tracer.span("eval", round_idx):
+                        record.update(self.local_test_on_all_clients(round_idx))
+                        record.update(self.test_global(round_idx))
+                records.add(record)
+                records.flush(round_idx)
+                if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
+                    with tracer.span("checkpoint", round_idx):
+                        self.save_checkpoint(ckpt_dir, round_idx + 1)
             round_idx += 1
 
     # ------------------------------------------------------- pipelined train
@@ -243,30 +282,35 @@ class FedAvgAPI(Checkpointable):
         -> gather -> chaos faults + participation mask -> mesh pad ->
         non-blocking `jax.device_put`. Runs on the prefetcher's staging
         thread; mirrors `train_one_round`'s host path exactly (the
-        pipelined == eager bit-identity pin depends on it)."""
+        pipelined == eager bit-identity pin depends on it). Spans route
+        through the installed tracer (the stager thread has no tracer
+        argument) and are tagged thread="stager" when staged ahead."""
         cfg = self.cfg
-        idx = client_sampling(round_idx, self.dataset.client_num,
-                              cfg.client_num_per_round)
-        faults = chaos.events(round_idx, len(idx)) if chaos is not None else None
-        x, y, counts = self.dataset.train.select(idx)
-        participation = None
-        if faults is not None:
-            x = apply_faults(faults, x)
-            participation = np.asarray(faults.participation, bool)
-        if self.mesh is not None:
-            n_before = counts.shape[0]
-            x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
-            if participation is not None and counts.shape[0] > n_before:
-                participation = np.concatenate(
-                    [participation,
-                     np.zeros(counts.shape[0] - n_before, bool)])
-        dx, dy, dc = (jax.device_put(x), jax.device_put(y),
-                      jax.device_put(counts))
-        dp = jax.device_put(participation) if participation is not None else None
+        tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
+        with tracer.span("stage", round_idx):
+            idx = client_sampling(round_idx, self.dataset.client_num,
+                                  cfg.client_num_per_round)
+            faults = chaos.events(round_idx, len(idx)) if chaos is not None else None
+            x, y, counts = self.dataset.train.select(idx)
+            participation = None
+            if faults is not None:
+                x = apply_faults(faults, x)
+                participation = np.asarray(faults.participation, bool)
+            if self.mesh is not None:
+                n_before = counts.shape[0]
+                x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
+                if participation is not None and counts.shape[0] > n_before:
+                    participation = np.concatenate(
+                        [participation,
+                         np.zeros(counts.shape[0] - n_before, bool)])
+        with tracer.span("h2d", round_idx):
+            dx, dy, dc = (jax.device_put(x), jax.device_put(y),
+                          jax.device_put(counts))
+            dp = jax.device_put(participation) if participation is not None else None
         return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
 
     def _train_pipelined(self, start_round, ckpt_dir, ckpt_every,
-                         metrics_logger, chaos, guard) -> None:
+                         metrics_logger, chaos, guard, tracer) -> None:
         """Asynchronous drive loop (`cfg.pipeline_depth` > 0).
 
         While round t executes, a background stager prepares cohorts
@@ -286,99 +330,100 @@ class FedAvgAPI(Checkpointable):
         prefetcher = CohortPrefetcher(
             lambda r: self._stage_cohort(r, chaos), depth=cfg.pipeline_depth)
         self._last_prefetcher = prefetcher  # test/ops introspection
-        pending: list[dict[str, Any]] = []  # records w/ device-array metrics
+        # records (possibly holding device-array metrics) defer through the
+        # shared RoundRecordLog; structured events (chaos, rollback) hit the
+        # ledger the moment they occur, so a crash mid-flush cannot lose them
+        records = RoundRecordLog(tracer, self.history, metrics_logger)
         inflight: deque = deque()
-
-        def flush():
-            if not pending:
-                return
-            for rec in jax.device_get(pending):
-                rec = {k: _scalar(v) for k, v in rec.items()}
-                self.history.append(rec)
-                if metrics_logger is not None:
-                    metrics_logger.log(
-                        {k: v for k, v in rec.items() if k != "round"},
-                        step=rec["round"])
-                log.info("round %d: %s", rec["round"],
-                         {k: v for k, v in rec.items() if k != "round"})
-            pending.clear()
 
         round_idx = start_round
         retries = 0
         try:
             while round_idx < cfg.comm_round:
-                t0 = time.time()
-                staged = prefetcher.get(round_idx)
-                # a rolled-back timeline can never leak a stale cohort in
-                assert staged.round_idx == round_idx
-                for ahead in range(1, cfg.pipeline_depth + 1):
-                    if round_idx + ahead < cfg.comm_round:
-                        prefetcher.prefetch(round_idx + ahead)
-                snapshot = None
-                if guard is not None:
-                    snapshot = (self._ckpt_tree(), self._ckpt_meta())
-                rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
-                                         round_idx)
-                if retries:
-                    rng = jax.random.fold_in(rng, retries)
-                args = [self.global_variables, self.agg_state, staged.x,
-                        staged.y, staged.counts, rng]
-                if staged.participation is not None:
-                    args.append(staged.participation)
-                self.global_variables, self.agg_state, train_metrics = \
-                    self.round_fn(*args)
-                inflight.append(train_metrics)
-                if len(inflight) > cfg.pipeline_depth:
-                    # rounds are serialized on device by the global-variables
-                    # dependency, so round t-depth is long done — blocking on
-                    # its tiny metric tree bounds run-ahead without stalling
-                    jax.block_until_ready(inflight.popleft())
-                is_test = (round_idx % cfg.frequency_of_the_test == 0
-                           or round_idx == cfg.comm_round - 1)
-                is_ckpt = bool(ckpt_dir) and (round_idx + 1) % ckpt_every == 0
-                if guard is not None:
-                    train_metrics = {
-                        k: float(v)
-                        for k, v in jax.device_get(train_metrics).items()}
-                    total = max(train_metrics.get("total", 1.0), 1.0)
-                    loss = train_metrics.get("loss_sum", 0.0) / total
-                    verdict = guard.inspect(round_idx, loss,
-                                            self.global_variables)
-                    if not verdict.ok and retries < guard.max_retries:
-                        retries += 1
-                        log.warning("guard: %s — rolled back, retrying with "
-                                    "fresh rng (%d/%d)", verdict.reason,
-                                    retries, guard.max_retries)
-                        self._ckpt_load(*snapshot)
-                        prefetcher.invalidate()
-                        inflight.clear()
-                        continue
-                    if not verdict.ok:
-                        log.warning("guard: %s — retries exhausted, "
-                                    "accepting the round", verdict.reason)
-                record = {"round": round_idx, "round_time": time.time() - t0}
-                if staged.faults is not None:
-                    record.update(chaos_summary(staged.faults))
-                    for k in ("participated_count", "quarantined_count"):
-                        if k in train_metrics:
-                            record[k] = train_metrics[k]
-                if guard is not None and retries:
-                    record["guard_retries"] = retries
-                retries = 0
-                if is_test:
-                    # eval reads the post-round model, so these dispatches
-                    # block on the round chain anyway — resolving now is free
-                    record.update(self.local_test_on_all_clients(round_idx))
-                    record.update(self.test_global(round_idx))
-                pending.append(record)
-                if guard is not None or is_test or is_ckpt:
-                    flush()
-                if is_ckpt:
-                    self.save_checkpoint(ckpt_dir, round_idx + 1)
+                with tracer.round(round_idx) as rspan:
+                    with tracer.span("stage_wait", round_idx):
+                        staged = prefetcher.get(round_idx)
+                    # a rolled-back timeline can never leak a stale cohort in
+                    assert staged.round_idx == round_idx
+                    for ahead in range(1, cfg.pipeline_depth + 1):
+                        if round_idx + ahead < cfg.comm_round:
+                            prefetcher.prefetch(round_idx + ahead)
+                    snapshot = None
+                    if guard is not None:
+                        snapshot = (self._ckpt_tree(), self._ckpt_meta())
+                    with tracer.span("dispatch", round_idx):
+                        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
+                                                 round_idx)
+                        if retries:
+                            rng = jax.random.fold_in(rng, retries)
+                        args = [self.global_variables, self.agg_state, staged.x,
+                                staged.y, staged.counts, rng]
+                        if staged.participation is not None:
+                            args.append(staged.participation)
+                        self.global_variables, self.agg_state, train_metrics = \
+                            self.round_fn(*args)
+                    inflight.append(train_metrics)
+                    if len(inflight) > cfg.pipeline_depth:
+                        # rounds are serialized on device by the global-variables
+                        # dependency, so round t-depth is long done — blocking on
+                        # its tiny metric tree bounds run-ahead without stalling
+                        with tracer.span("device_wait", round_idx):
+                            jax.block_until_ready(inflight.popleft())
+                    is_test = (round_idx % cfg.frequency_of_the_test == 0
+                               or round_idx == cfg.comm_round - 1)
+                    is_ckpt = bool(ckpt_dir) and (round_idx + 1) % ckpt_every == 0
+                    if guard is not None:
+                        with tracer.span("metrics_fetch", round_idx):
+                            train_metrics = {
+                                k: float(v)
+                                for k, v in jax.device_get(train_metrics).items()}
+                        total = max(train_metrics.get("total", 1.0), 1.0)
+                        loss = train_metrics.get("loss_sum", 0.0) / total
+                        with tracer.span("guard_verdict", round_idx):
+                            verdict = guard.inspect(round_idx, loss,
+                                                    self.global_variables)
+                        tracer.event("guard_verdict", round=round_idx,
+                                     ok=verdict.ok, reason=verdict.reason)
+                        if not verdict.ok and retries < guard.max_retries:
+                            retries += 1
+                            log.warning("guard: %s — rolled back, retrying with "
+                                        "fresh rng (%d/%d)", verdict.reason,
+                                        retries, guard.max_retries)
+                            tracer.event("guard_rollback", round=round_idx,
+                                         retry=retries)
+                            self._ckpt_load(*snapshot)
+                            prefetcher.invalidate()
+                            inflight.clear()
+                            continue
+                        if not verdict.ok:
+                            log.warning("guard: %s — retries exhausted, "
+                                        "accepting the round", verdict.reason)
+                            tracer.event("guard_exhausted", round=round_idx)
+                    record = {"round": round_idx, "round_time": rspan.elapsed()}
+                    if staged.faults is not None:
+                        record.update(chaos_summary(staged.faults))
+                        for k in ("participated_count", "quarantined_count"):
+                            if k in train_metrics:
+                                record[k] = train_metrics[k]
+                    if guard is not None and retries:
+                        record["guard_retries"] = retries
+                    retries = 0
+                    if is_test:
+                        # eval reads the post-round model, so these dispatches
+                        # block on the round chain anyway — resolving now is free
+                        with tracer.span("eval", round_idx):
+                            record.update(self.local_test_on_all_clients(round_idx))
+                            record.update(self.test_global(round_idx))
+                    records.add(record)
+                    if guard is not None or is_test or is_ckpt:
+                        records.flush(round_idx)
+                    if is_ckpt:
+                        with tracer.span("checkpoint", round_idx):
+                            self.save_checkpoint(ckpt_dir, round_idx + 1)
                 round_idx += 1
         finally:
             prefetcher.close()
-        flush()
+        records.flush()
 
     # -- checkpoint state (utils.checkpoint.Checkpointable): global model +
     # aggregator state + history (SURVEY §5: the reference's core FedAvg
@@ -387,12 +432,16 @@ class FedAvgAPI(Checkpointable):
         return {"variables": self.global_variables, "agg_state": self.agg_state}
 
     def _ckpt_meta(self):
-        return {"history": self.history}
+        # copy: the snapshot must not alias the live list a later flush
+        # appends to
+        return {"history": list(self.history)}
 
     def _ckpt_load(self, tree, meta):
         self.global_variables = tree["variables"]
         self.agg_state = tree["agg_state"]
-        self.history = list(meta.get("history", []))
+        # in place: the drive loop's RoundRecordLog holds this list — a
+        # rebind here would strand its post-rollback flushes on a stale copy
+        self.history[:] = meta.get("history", [])
 
     # ------------------------------------------------------------------- eval
     def test_global(self, round_idx: int) -> dict[str, float]:

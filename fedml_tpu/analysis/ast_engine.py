@@ -34,6 +34,10 @@ itself the `bare-suppression` finding):
   `jax.device_get` of the whole tree with host-side iteration —
   `{k: float(v) for k, v in jax.device_get(m).items()}` is clean because
   the iterable resolves everything in a single transfer.
+- `naked-timer-in-drive-loop` (algorithms/ drivers only): raw
+  `time.time()`/`time.perf_counter()` reads inside a drive loop — async
+  dispatch makes them measure the tunnel, not the device. Blessed: the
+  telemetry Span API and `jax.block_until_ready`-bracketed timers.
 """
 
 from __future__ import annotations
@@ -387,6 +391,79 @@ class _DriveLoopFetch(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _NakedTimer(ast.NodeVisitor):
+    """naked-timer-in-drive-loop: raw wall-clock reads inside algorithms/
+    drive loops.
+
+    `time.time()` / `time.perf_counter()` / `time.monotonic()` /
+    `time.process_time()` bracketing a jitted call measures DISPATCH
+    latency, not compute — jax returns futures, so the timer closes before
+    the device finishes. That is exactly how the r01–r05 throughput
+    trajectory went flat without anyone noticing (PERF.md): the numbers
+    timed the tunnel, and a regression in the round program hid behind
+    async dispatch. Two blessed idioms:
+
+    - the telemetry Span API (`tracer.span(...)` context managers,
+      `tracer.now()` — spans are what the perf gate audits); a loop whose
+      body opens a `.span(...)` / `.round(...)` context is considered
+      instrumented and its remaining timer reads are measurement plumbing;
+    - a loop body that calls `jax.block_until_ready(...)` — the timer pair
+      then measures completed device work (tools/bench_* style).
+    """
+
+    _TIMER_TAILS = {"time", "perf_counter", "monotonic", "process_time"}
+    _BLESSING_ATTRS = {"block_until_ready", "span", "round"}
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self._blessed_loops = 0
+        self._loops = 0
+
+    @classmethod
+    def _loop_blessed(cls, node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name and name.split(".")[-1] in cls._BLESSING_ATTRS:
+                    return True
+        return False
+
+    def _visit_loop(self, node, parts):
+        blessed = self._loop_blessed(node)
+        self._loops += 1
+        self._blessed_loops += blessed
+        for stmt in parts:
+            self.visit(stmt)
+        self._blessed_loops -= blessed
+        self._loops -= 1
+
+    def visit_For(self, node: ast.For):
+        self.visit(node.iter)
+        self._visit_loop(node, node.body + node.orelse)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While):
+        self.visit(node.test)
+        self._visit_loop(node, node.body + node.orelse)
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if (name.startswith("time.")
+                and name.split(".")[-1] in self._TIMER_TAILS
+                and self._loops and not self._blessed_loops
+                and not is_suppressed(self.lines, node.lineno,
+                                      "naked-timer-in-drive-loop")):
+            self.findings.append(Finding(
+                "naked-timer-in-drive-loop", f"{self.path}:{node.lineno}",
+                f"{name}() in a drive loop times async dispatch, not "
+                "compute — record a telemetry span (tracer.span/round) or "
+                "bracket the timed region with jax.block_until_ready"))
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -408,6 +485,7 @@ def lint_source(source: str, path: str) -> List[Finding]:
     # paths, so the scope survives any checkout location)
     if "algorithms" in path.replace(os.sep, "/").split("/"):
         _DriveLoopFetch(path, lines, findings).visit(tree)
+        _NakedTimer(path, lines, findings).visit(tree)
     for lineno, rules, reason in iter_suppressions(source):
         if reason is None and not is_suppressed(lines, lineno,
                                                 "bare-suppression"):

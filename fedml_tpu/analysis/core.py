@@ -20,6 +20,11 @@ RULES = {
     "blocking-fetch-in-drive-loop": "per-item float()/np.asarray()/.item() "
                                     "host sync inside an algorithms/ driver "
                                     "round loop",
+    "naked-timer-in-drive-loop": "raw time.time()/perf_counter() timing in "
+                                 "an algorithms/ drive loop (measures async "
+                                 "dispatch, not compute — use telemetry "
+                                 "spans or block_until_ready-bracketed "
+                                 "timers)",
     "partition-coverage": "param tree leaf matches no PartitionSpec rule",
     # HLO-layer rules (hlo_engine / comms): lowered-program collectives
     "collective-in-loop": "loop-invariant collective inside a while/scan body",

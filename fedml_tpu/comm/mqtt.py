@@ -24,6 +24,7 @@ import struct
 import threading
 from typing import Callable
 
+from fedml_tpu import telemetry
 from fedml_tpu.comm.message import Message
 from fedml_tpu.robustness.retry import RetryError, RetryPolicy, call_with_retry
 
@@ -229,6 +230,8 @@ class MqttClient:
         full-jitter retries (robustness.retry — the shared policy also used
         by data downloads). Returns False when shut down or out of retries."""
 
+        attempts = [1]  # first try + one per on_retry callback
+
         def reconnect_once():
             sock = self._connect()
             with self._send_lock:
@@ -237,19 +240,26 @@ class MqttClient:
                     self._pid = (self._pid % 0xFFFF) + 1
                     sock.sendall(_subscribe_packet(self._pid, topic))
 
+        def on_retry(attempt, exc, delay):
+            attempts[0] = attempt + 2
+            log.info("mqtt %s: reconnect attempt %d failed (%s), next in "
+                     "%.2fs", self._client_id, attempt + 1, exc, delay)
+
         try:
             call_with_retry(
                 reconnect_once,
                 policy=self._reconnect_policy,
                 abort=self._stop.is_set,
-                on_retry=lambda attempt, exc, delay: log.info(
-                    "mqtt %s: reconnect attempt %d failed (%s), next in "
-                    "%.2fs", self._client_id, attempt + 1, exc, delay),
+                on_retry=on_retry,
             )
         except (RetryError, OSError):
+            telemetry.emit("mqtt_reconnect", client_id=self._client_id,
+                           ok=False, attempts=attempts[0])
             return False
         log.info("mqtt %s: reconnected and resubscribed %d topic(s)",
                  self._client_id, len(self._cbs))
+        telemetry.emit("mqtt_reconnect", client_id=self._client_id,
+                       ok=True, attempts=attempts[0])
         return True
 
     def _loop(self):

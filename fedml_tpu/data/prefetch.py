@@ -35,11 +35,14 @@ Correctness contract (tests/test_pipeline.py):
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable
 
 import numpy as np
+
+from fedml_tpu import telemetry
 
 
 @dataclass
@@ -81,12 +84,16 @@ class CohortPrefetcher:
         self.staged_rounds: list[int] = []   # every staging that actually ran
         self.consumed_rounds: list[int] = []
         self.misses = 0
+        self.invalidations = 0
+        self._staged_at: dict[int, float] = {}  # round -> staging-done time
 
     def _submit(self, round_idx: int) -> Future:
         def job():
             # the append is atomic under the GIL; single worker => ordered
             self.staged_rounds.append(round_idx)
-            return self._stage_fn(round_idx)
+            staged = self._stage_fn(round_idx)
+            self._staged_at[round_idx] = time.monotonic()
+            return staged
 
         return self._pool.submit(job)
 
@@ -105,11 +112,21 @@ class CohortPrefetcher:
         donate. A miss stages on demand (same bytes, staging is pure)."""
         with self._lock:
             fut = self._inflight.pop(round_idx, None)
-            if fut is None:
+            miss = fut is None
+            depth_in_flight = len(self._inflight)
+            if miss:
                 self.misses += 1
                 fut = self._submit(round_idx)
         staged = fut.result()
         self.consumed_rounds.append(round_idx)
+        # pipeline-occupancy gauge: how deep the pipeline was when this
+        # round was consumed and how long its cohort sat staged-ahead
+        # (0 on a miss — it was staged on demand just now)
+        done_at = self._staged_at.pop(round_idx, None)
+        ahead_s = max(0.0, time.monotonic() - done_at) if done_at else 0.0
+        telemetry.gauge("prefetch_occupancy", round=round_idx,
+                        inflight=depth_in_flight, ahead_s=round(ahead_s, 6),
+                        miss=miss)
         return staged
 
     def invalidate(self) -> None:
@@ -117,9 +134,13 @@ class CohortPrefetcher:
         re-stages from scratch, and no cohort scheduled before the rollback
         can be consumed after it."""
         with self._lock:
+            dropped = len(self._inflight)
             for fut in self._inflight.values():
                 fut.cancel()  # best-effort; an already-running job just gets dropped
             self._inflight.clear()
+            self._staged_at.clear()
+        self.invalidations += 1
+        telemetry.gauge("prefetch_invalidate", dropped=dropped)
 
     def close(self) -> None:
         self.invalidate()

@@ -71,6 +71,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--pipeline_depth", type=int, default=2,
                         help="cohort prefetch depth for the FedAvg-family "
                              "drive loop (0 = eager)")
+    # graft-trace observability (fedml_tpu.telemetry): TRACE.jsonl is
+    # always written to <run_dir>/TRACE.jsonl; these knobs add sinks
+    parser.add_argument("--trace_summary", type=int, default=0,
+                        help="1 = print an end-of-run per-phase p50/p95 "
+                             "span table")
+    parser.add_argument("--trace_wandb", type=int, default=0,
+                        help="1 = mirror per-round phase durations into the "
+                             "metrics logger as trace/<phase>_s")
+    parser.add_argument("--profile_rounds", type=str, default=None,
+                        help="A:B = capture a jax.profiler trace window "
+                             "covering rounds [A, B) into --profile_dir")
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="TensorBoard trace dir for --profile_rounds "
+                             "(default <run_dir>/trace)")
     return parser
 
 
@@ -92,11 +106,43 @@ def robustness_from_args(args):
     return chaos, guard
 
 
+def tracer_from_args(args, metrics_logger=None):
+    """The run's graft-trace Tracer: TRACE.jsonl manifest in run_dir
+    (always on — it is the run's flight recorder), optional wandb mirror
+    (--trace_wandb) and jax.profiler window (--profile_rounds A:B)."""
+    import os
+
+    from fedml_tpu import telemetry
+
+    run_dir = getattr(args, "run_dir", None)
+    jsonl = os.path.join(run_dir, "TRACE.jsonl") if run_dir else None
+    if jsonl:
+        os.makedirs(run_dir, exist_ok=True)
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir is None and run_dir:
+        profile_dir = os.path.join(run_dir, "trace")
+    return telemetry.Tracer(
+        jsonl_path=jsonl,
+        metrics_logger=metrics_logger if getattr(args, "trace_wandb", 0)
+        else None,
+        profile_rounds=getattr(args, "profile_rounds", None),
+        profile_dir=profile_dir,
+        run_meta={"model": args.model, "dataset": args.dataset,
+                  "clients": args.client_num_in_total,
+                  "clients_per_round": args.client_num_per_round,
+                  "batch_size": args.batch_size,
+                  "pipeline_depth": args.pipeline_depth})
+
+
 def config_from_args(args) -> FedConfig:
     d = {k: v for k, v in vars(args).items() if v is not None}
     d.pop("data_dir", None)
     d.pop("ckpt_dir", None)
     d.pop("run_dir", None)
+    # observability knobs configure the tracer, not the round program
+    for k in ("trace_summary", "trace_wandb", "profile_rounds",
+              "profile_dir"):
+        d.pop(k, None)
     if d.get("mesh_shape"):
         d["mesh_shape"] = tuple(d["mesh_shape"])
     else:

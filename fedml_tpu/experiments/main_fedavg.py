@@ -13,7 +13,12 @@ from __future__ import annotations
 import argparse
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
-from fedml_tpu.experiments.common import add_args, robustness_from_args, setup_run
+from fedml_tpu.experiments.common import (
+    add_args,
+    robustness_from_args,
+    setup_run,
+    tracer_from_args,
+)
 from fedml_tpu.utils.logging import MetricsLogger
 
 
@@ -26,9 +31,15 @@ def main(argv=None, aggregator_name: str = "fedavg", extra_args=None):
     logger = MetricsLogger(run_dir=args.run_dir, config=vars(args))
     api = FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
     chaos, guard = robustness_from_args(args)
-    history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger,
-                        chaos=chaos, guard=guard)
+    tracer = tracer_from_args(args, metrics_logger=logger)
+    try:
+        history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger,
+                            chaos=chaos, guard=guard, tracer=tracer)
+    finally:
+        tracer.close()
     logger.finish()
+    if getattr(args, "trace_summary", 0):
+        print(tracer.summary_table(), flush=True)
     return history
 
 

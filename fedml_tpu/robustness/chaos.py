@@ -22,6 +22,8 @@ from typing import Dict, NamedTuple, Optional
 
 import numpy as np
 
+from fedml_tpu import telemetry
+
 
 class FaultEvents(NamedTuple):
     """Host-side fault decisions for one round (numpy, length n_clients)."""
@@ -67,8 +69,12 @@ class FaultPlan:
         # faults are moot; keep the masks disjoint so counts add up
         nan &= ~drop
         corrupt &= ~drop & ~nan
-        return FaultEvents(participation=~drop, nan_mask=nan,
-                           corrupt_mask=corrupt)
+        events = FaultEvents(participation=~drop, nan_mask=nan,
+                             corrupt_mask=corrupt)
+        telemetry.emit("chaos_inject", round=round_idx,
+                       dropped=int(drop.sum()), nan=int(nan.sum()),
+                       corrupt=int(corrupt.sum()))
+        return events
 
 
 def apply_faults(events: FaultEvents, x: np.ndarray) -> np.ndarray:

@@ -62,7 +62,9 @@ class RoundGuard:
                 global_variables: Optional[Any] = None) -> GuardVerdict:
         """Judge one completed round. Accepted losses enter the history;
         rejected rounds leave it untouched (a spike must not poison the
-        baseline it is judged against)."""
+        baseline it is judged against). The drive loop ledgers every
+        verdict as a `guard_verdict` telemetry event — emitted there, not
+        here, so user-supplied guard objects are ledgered identically."""
         loss = float(loss)
         if not np.isfinite(loss):
             return GuardVerdict(False, f"round {round_idx}: non-finite train "

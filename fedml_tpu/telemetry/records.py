@@ -1,0 +1,81 @@
+"""The one round-record path shared by the eager and pipelined drive loops.
+
+Before graft-trace, `_train_eager` and `_train_pipelined` each assembled,
+logged, and appended history records with their own copy of the same code
+(and the pipelined copy deferred the host fetch, which a mid-flush crash
+could silently lose). `RoundRecordLog` is the single owner now:
+
+- `add(record)` parks a record that may still hold device-resident values
+  (the pipelined loop's deferred train metrics);
+- `flush()` performs ONE `jax.device_get` over everything pending (inside a
+  `metrics_fetch` span), scalarizes, appends to `history` byte-compatibly
+  with the pre-telemetry format (checkpoint resume depends on it), mirrors
+  to the metrics logger, writes the round log line, and emits a
+  `round_committed` ledger event carrying the resolved robustness counters.
+
+The eager loop calls `add` + `flush` every round; the pipelined loop calls
+`add` per round and `flush` only at its sync points (guard, eval,
+checkpoint, end of drive) — exactly the old deferral structure, minus the
+duplication.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from fedml_tpu.telemetry.tracer import NULL_TRACER
+
+log = logging.getLogger("fedml_tpu.fedavg")
+
+#: record keys mirrored into the `round_committed` ledger event — the
+#: robustness counters whose loss in a mid-flush crash was the PR 6 bug.
+_LEDGER_KEYS = ("participated_count", "quarantined_count", "guard_retries",
+                "chaos_dropped", "chaos_nan", "chaos_corrupt")
+
+
+def _scalar(v: Any) -> Any:
+    """Device/numpy scalars -> python floats; host ints/strs unchanged."""
+    return float(v) if hasattr(v, "dtype") else v
+
+
+class RoundRecordLog:
+    """Owns pending round records from `add()` until `flush()` commits them
+    to history + metrics logger + the telemetry ledger."""
+
+    def __init__(self, tracer=None, history: Optional[List[Dict]] = None,
+                 metrics_logger=None):
+        self.tracer = tracer or NULL_TRACER
+        self.history = history if history is not None else []
+        self.metrics_logger = metrics_logger
+        self._pending: List[Dict[str, Any]] = []
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def add(self, record: Dict[str, Any]) -> None:
+        self._pending.append(record)
+
+    def flush(self, round_idx: Optional[int] = None) -> None:
+        """One deferred host sync for every pending record (the pipelined
+        loop's single-device_get-per-flush contract), then commit."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        with self.tracer.span("metrics_fetch", round_idx,
+                              records=len(pending)):
+            pending = jax.device_get(pending)
+        for rec in pending:
+            rec = {k: _scalar(v) for k, v in rec.items()}
+            self.history.append(rec)
+            if self.metrics_logger is not None:
+                self.metrics_logger.log(
+                    {k: v for k, v in rec.items() if k != "round"},
+                    step=rec["round"])
+            log.info("round %d: %s", rec["round"],
+                     {k: v for k, v in rec.items() if k != "round"})
+            self.tracer.event(
+                "round_committed", round=rec["round"],
+                **{k: rec[k] for k in _LEDGER_KEYS if k in rec})

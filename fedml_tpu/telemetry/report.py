@@ -1,0 +1,205 @@
+"""Fold a TRACE.jsonl into a BENCH-style report + the perf-regression gate.
+
+The ROADMAP (open item 5) asks for the gate outright: the r01–r05
+throughput trajectory sat flat with nothing stopping it from silently
+regressing. `fold()` turns a trace manifest into the same shape of JSON
+the BENCH_*.json artifacts carry (rounds/s, per-phase p50/p95, span
+coverage, event counts); `run_gate()` compares a measured rounds/s against
+the newest checked-in BENCH baseline within a tolerance, skipping honestly
+when the environments are incomparable (platform or `cpu_capped`
+mismatch — a 1-core CPU box must not be judged against a TPU number) and
+producing a readable diff when it trips. `tools/trace_report.py` is the
+CLI; ci_smoke.sh runs it after a short drive on every commit.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Gate floor as a fraction of the baseline rounds/s. Deliberately loose
+#: (0.5x): the CI drive is short and a shared box is noisy; the gate exists
+#: to catch *silent structural* slowdowns (an accidental per-round host
+#: sync, a dropped donation), not 5% jitter.
+DEFAULT_TOLERANCE = 0.5
+
+#: Workload keys that must match between the trace's run_meta and the BENCH
+#: baseline for rounds/s to be comparable at all.
+_WORKLOAD_KEYS = ("model", "clients", "clients_per_round", "batch_size")
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def _pcts(durs: List[float]) -> Dict[str, float]:
+    durs = sorted(durs)
+    return {
+        "count": len(durs),
+        "total_s": round(sum(durs), 6),
+        "p50_s": round(durs[len(durs) // 2], 6),
+        "p95_s": round(durs[min(len(durs) - 1, int(len(durs) * 0.95))], 6),
+    }
+
+
+def _union_len(intervals: List[Tuple[float, float]]) -> float:
+    """Total length covered by possibly-overlapping [lo, hi) intervals."""
+    total, cursor = 0.0, None
+    for lo, hi in sorted(intervals):
+        if cursor is None or lo > cursor:
+            total += hi - lo
+            cursor = hi
+        elif hi > cursor:
+            total += hi - cursor
+            cursor = hi
+    return total
+
+
+def coverage(records: List[Dict[str, Any]]) -> float:
+    """Fraction of total round wall-clock covered by the union of
+    main-thread phase spans nested inside each `round` span — the
+    acceptance bar is >= 0.95 (a drive loop whose time mostly falls
+    *between* spans is a drive loop we still can't see into)."""
+    rounds = [s for s in records
+              if s.get("type") == "span" and s.get("name") == "round"]
+    phases = [s for s in records
+              if s.get("type") == "span" and s.get("thread") == "main"
+              and s.get("name") not in ("round", "drive")]
+    total = covered = 0.0
+    for r in rounds:
+        lo, hi = r["t0"], r["t0"] + r["dur_s"]
+        total += r["dur_s"]
+        windows = []
+        for p in phases:
+            if p.get("round") != r["round"]:
+                continue
+            plo, phi = max(p["t0"], lo), min(p["t0"] + p["dur_s"], hi)
+            if phi > plo:
+                windows.append((plo, phi))
+        covered += _union_len(windows)
+    return covered / total if total else 0.0
+
+
+def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """TRACE.jsonl records -> BENCH-style report dict."""
+    meta = next((r for r in records if r.get("type") == "meta"), {})
+    spans = [r for r in records if r.get("type") == "span"]
+    events = [r for r in records if r.get("type") == "event"]
+
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s["dur_s"])
+
+    round_durs = by_name.get("round", [])
+    # Drive span total is the honest denominator (includes inter-round
+    # work: final pipeline flush, end-of-drive checkpoint); fall back to
+    # the round-span sum for partial traces.
+    wall_s = sum(by_name.get("drive", [])) or sum(round_durs)
+    rps = len(round_durs) / wall_s if wall_s else 0.0
+
+    event_counts: Dict[str, int] = {}
+    for e in events:
+        event_counts[e["kind"]] = event_counts.get(e["kind"], 0) + 1
+
+    report = {
+        "metric": "fedavg_drive_rounds_per_sec",
+        "value": round(rps, 4),
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "rounds": len(round_durs),
+        "wall_s": round(wall_s, 4),
+        "coverage": round(coverage(records), 4),
+        "phases": {name: _pcts(durs) for name, durs in sorted(by_name.items())},
+        "events": dict(sorted(event_counts.items())),
+    }
+    for k in ("platform", "cpu_cores", "cpu_capped", *_WORKLOAD_KEYS):
+        if k in meta:
+            report[k] = meta[k]
+    return report
+
+
+# ------------------------------------------------------------------- gate
+
+def newest_bench(root: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """(path, parsed) of the newest BENCH_*.json carrying a rounds/s
+    number. 'Newest' is the rNN suffix when present (BENCH_r06 beats
+    BENCH_r01 regardless of mtime), mtime otherwise."""
+    def order(path: str):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        return (1, int(m.group(1))) if m else (0, os.path.getmtime(path))
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json")),
+                       key=order, reverse=True):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        if baseline_rounds_per_sec(parsed) is not None:
+            return path, parsed
+    return None
+
+
+def baseline_rounds_per_sec(parsed: Dict[str, Any]) -> Optional[float]:
+    """rounds/s from either BENCH schema: the pipeline A/B's eager arm
+    (arms["0"], r06) or the flat drive metric (rounds_per_sec, r01–r05)."""
+    arms = parsed.get("arms")
+    if isinstance(arms, dict) and "0" in arms:
+        return arms["0"].get("rounds_per_sec")
+    return parsed.get("rounds_per_sec")
+
+
+def run_gate(report: Dict[str, Any], bench_path: str,
+             bench_parsed: Dict[str, Any],
+             tolerance: float = DEFAULT_TOLERANCE
+             ) -> Tuple[bool, bool, str]:
+    """(ok, skipped, message). Skips (ok=True) when baseline and measured
+    environments are incomparable; otherwise fails when measured rounds/s
+    drops below tolerance * baseline."""
+    baseline = baseline_rounds_per_sec(bench_parsed)
+    bench_name = os.path.basename(bench_path)
+    for key, label in (("platform", "platform"),
+                       ("cpu_capped", "cpu_capped")):
+        b, m = bench_parsed.get(key), report.get(key)
+        if b is not None and m is not None and b != m:
+            return True, True, (
+                f"perf-regression gate: SKIP — {label} mismatch "
+                f"(baseline {bench_name} {label}={b!r}, measured {m!r}); "
+                f"rounds/s not comparable across environments")
+    for key in _WORKLOAD_KEYS:
+        b, m = bench_parsed.get(key), report.get(key)
+        if b is not None and m is not None and b != m:
+            return True, True, (
+                f"perf-regression gate: SKIP — workload mismatch on "
+                f"{key!r} (baseline {bench_name} has {b!r}, measured "
+                f"{m!r}); rerun with a matching workload")
+    measured = report.get("value", 0.0)
+    floor = baseline * tolerance
+    ratio = measured / baseline if baseline else 0.0
+    env = (f"platform={bench_parsed.get('platform')!r}, "
+           f"cpu_capped={bench_parsed.get('cpu_capped')}")
+    if measured >= floor:
+        return True, False, (
+            f"perf-regression gate: PASS\n"
+            f"  baseline  {bench_name:<16} {baseline:8.2f} rounds/s ({env})\n"
+            f"  measured  TRACE            {measured:8.2f} rounds/s "
+            f"({ratio:.2f}x baseline, floor {tolerance:.2f}x)")
+    return False, False, (
+        f"perf-regression gate: FAIL\n"
+        f"  baseline  {bench_name:<16} {baseline:8.2f} rounds/s ({env})\n"
+        f"  measured  TRACE            {measured:8.2f} rounds/s "
+        f"({ratio:.2f}x baseline, floor {tolerance:.2f}x)\n"
+        f"  the drive loop regressed past the allowed tolerance: look for a\n"
+        f"  new per-round host sync (graft-lint blocking-fetch rule), a lost\n"
+        f"  buffer donation, or compile-cache misses (TRACE.jsonl event\n"
+        f"  ledger, kind=compile_cache), then rerun tools/bench_pipeline.py\n"
+        f"  to re-baseline deliberately if the slowdown is intended")

@@ -1,0 +1,372 @@
+"""graft-trace core: monotonic-clock phase spans + a structured event ledger.
+
+The drive loop's wall-clock story was invisible: PR 5 interleaved background
+staging, donated dispatch, and deferred host syncs, and the only timing left
+was a `time.time()` pair around the whole round — which, in an async loop,
+measures dispatch latency, not where the time went (the r01–r05
+flat-trajectory footgun; see the `naked-timer-in-drive-loop` lint rule).
+This module is the replacement: a zero-dependency `Tracer` that records
+
+- **spans**: named monotonic-clock intervals (`stage`, `h2d`, `dispatch`,
+  `device_wait`, `metrics_fetch`, `eval`, `checkpoint`, `guard_verdict`,
+  ...) per round, from any thread. Spans are recorded *around* jitted
+  calls, never inside traces — the tracer never enters a jaxpr, so lowered
+  programs, COMMS_BUDGET.json, and the PR 4/5 bit-identity pins are
+  untouched by its presence.
+- **events**: schema-checked ledger entries (chaos injections, guard
+  verdicts/rollbacks, MQTT reconnects, compile-cache activity, committed
+  round records). Events are flushed to the JSONL sink the moment they
+  occur, so a crash mid-run (or mid-flush of the pipelined loop's deferred
+  metrics) cannot lose what already happened.
+- **gauges**: free-form instantaneous measurements (pipeline occupancy,
+  stage-ahead latency) with no cross-mode equality contract — the
+  eager-vs-pipelined event-sequence pin (tests/test_telemetry.py) covers
+  events only.
+
+Sinks: an always-on in-memory store (summary tables, tests), an optional
+JSONL file (`TRACE.jsonl`, one flushed line per record), an optional
+metrics-logger adapter (per-round `trace/<phase>_s` keys through the
+existing wandb seam), and an optional `jax.profiler` trace window
+(`profile_rounds="A:B"` captures rounds [A, B) into a TensorBoard dir).
+
+Module-level seam: collaborators that should not carry a tracer argument
+(chaos harness, round guard, MQTT transport, compile cache, prefetcher)
+call `telemetry.emit(...)` / `telemetry.gauge(...)`, which route to the
+installed tracer and no-op when none is installed. `FedAvgAPI.train`
+installs its tracer for the duration of the drive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+# ----------------------------------------------------------------- schemas
+
+#: Stable event ledger schemas: kind -> required field names. Extra fields
+#: are allowed; a missing required field or an unknown kind is a ValueError
+#: at emit time (tests/test_telemetry.py round-trips every kind).
+EVENT_SCHEMAS: Dict[str, set] = {
+    # chaos harness (robustness/chaos.py): one per FaultPlan.events() call
+    "chaos_inject": {"round", "dropped", "nan", "corrupt"},
+    # round guard (robustness/guard.py + drive loop)
+    "guard_verdict": {"round", "ok", "reason"},
+    "guard_rollback": {"round", "retry"},
+    "guard_exhausted": {"round"},
+    # unified record path (telemetry/records.py): the history record landed
+    "round_committed": {"round"},
+    # checkpointing (utils/checkpoint.py)
+    "checkpoint_save": {"step"},
+    # self-healing comms (comm/mqtt.py)
+    "mqtt_reconnect": {"client_id", "ok", "attempts"},
+    # persistent compile cache (utils/cache.py via jax.monitoring)
+    "compile_cache": {"name"},
+    # round-program construction (algorithms/engine.py)
+    "round_fn_built": {"program", "donate"},
+}
+
+
+def _thread_label() -> str:
+    name = threading.current_thread().name
+    return "stager" if name.startswith("cohort-prefetch") else "main"
+
+
+class _SpanHandle:
+    """Live span: open time is queryable before the span closes (the drive
+    loop reads `elapsed()` for the history record's `round_time` while the
+    round span is still open)."""
+
+    __slots__ = ("_tracer", "t0")
+
+    def __init__(self, tracer: "Tracer", t0: float):
+        self._tracer = tracer
+        self.t0 = t0
+
+    def elapsed(self) -> float:
+        return self._tracer.now() - self.t0
+
+
+class Tracer:
+    """Thread-safe span/event/gauge recorder with pluggable clock and sinks.
+
+    `clock` is injectable (tests drive a fake monotonic clock);
+    `jsonl_path` enables the durable sink (every record is written and
+    flushed immediately); `metrics_logger` mirrors per-round phase totals
+    as `trace/<phase>_s` through the wandb-compatible seam;
+    `profile_rounds="A:B"` + `profile_dir` arm a `jax.profiler` window
+    capturing rounds [A, B).
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 metrics_logger=None,
+                 profile_rounds: Optional[str] = None,
+                 profile_dir: Optional[str] = None,
+                 run_meta: Optional[Dict[str, Any]] = None,
+                 mode: str = "w"):
+        self._clock = clock or time.perf_counter
+        self._lock = threading.Lock()
+        self.spans: List[Dict[str, Any]] = []
+        self.events: List[Dict[str, Any]] = []
+        self.gauges: List[Dict[str, Any]] = []
+        self._metrics_logger = metrics_logger
+        self._round_phase_acc: Dict[int, Dict[str, float]] = {}
+        self._profile_window = (parse_profile_rounds(profile_rounds)
+                                if profile_rounds else None)
+        self._profile_dir = profile_dir or "/tmp/fedml_tpu_trace"
+        self._profiling = False
+        self._file = None
+        if jsonl_path:
+            parent = os.path.dirname(jsonl_path)
+            if parent:  # ckpt_dir may not exist until the first save
+                os.makedirs(parent, exist_ok=True)
+            self._file = open(jsonl_path, mode)
+        self._write({"type": "meta", "version": 1, "clock": "monotonic",
+                     **(run_meta or {})})
+
+    # ------------------------------------------------------------- plumbing
+    def now(self) -> float:
+        """The tracer's monotonic clock — the blessed way to read time in a
+        drive loop (see the naked-timer-in-drive-loop lint rule)."""
+        return self._clock()
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.write(json.dumps(rec, default=float) + "\n")
+                self._file.flush()  # durable the moment it happened
+
+    # ---------------------------------------------------------------- spans
+    @contextmanager
+    def span(self, name: str, round_idx: Optional[int] = None, **attrs):
+        t0 = self.now()
+        handle = _SpanHandle(self, t0)
+        try:
+            yield handle
+        finally:
+            dur = self.now() - t0
+            rec = {"type": "span", "name": name, "round": round_idx,
+                   "thread": _thread_label(), "t0": t0, "dur_s": dur}
+            if attrs:
+                rec.update(attrs)
+            with self._lock:
+                self.spans.append(rec)
+                if (self._metrics_logger is not None and round_idx is not None
+                        and name not in ("round", "drive")):
+                    acc = self._round_phase_acc.setdefault(round_idx, {})
+                    acc[name] = acc.get(name, 0.0) + dur
+            self._write(rec)
+
+    @contextmanager
+    def round(self, round_idx: int):
+        """One drive-loop round: the parent span every phase nests under,
+        plus the `jax.profiler` window trigger and the metrics-logger
+        phase-total flush."""
+        self._profile_edge(round_idx, starting=True)
+        try:
+            with self.span("round", round_idx) as handle:
+                yield handle
+        finally:
+            self._profile_edge(round_idx, starting=False)
+            self._flush_phase_totals(round_idx)
+
+    def _flush_phase_totals(self, round_idx: int) -> None:
+        if self._metrics_logger is None:
+            return
+        with self._lock:
+            acc = self._round_phase_acc.pop(round_idx, None)
+        if acc:
+            self._metrics_logger.log(
+                {f"trace/{name}_s": round(dur, 6) for name, dur in acc.items()},
+                step=round_idx)
+
+    def _profile_edge(self, round_idx: int, starting: bool) -> None:
+        if self._profile_window is None:
+            return
+        lo, hi = self._profile_window
+        try:
+            import jax
+            if starting and round_idx == lo and not self._profiling:
+                jax.profiler.start_trace(self._profile_dir)
+                self._profiling = True
+            elif not starting and round_idx == hi - 1 and self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = False
+        except Exception:  # profiler unavailable on this backend — trace on
+            self._profile_window = None
+
+    # --------------------------------------------------------------- events
+    def event(self, kind: str, **fields) -> None:
+        """Ledger entry, persisted (flushed) the moment it occurs."""
+        required = EVENT_SCHEMAS.get(kind)
+        if required is None:
+            raise ValueError(
+                f"unknown telemetry event kind {kind!r}; known: "
+                f"{sorted(EVENT_SCHEMAS)}")
+        missing = required - fields.keys()
+        if missing:
+            raise ValueError(
+                f"event {kind!r} missing required field(s) {sorted(missing)}")
+        rec = {"type": "event", "kind": kind, "t": self.now(),
+               "thread": _thread_label(), **fields}
+        with self._lock:
+            self.events.append(rec)
+        self._write(rec)
+
+    def gauge(self, name: str, **fields) -> None:
+        """Instantaneous measurement (pipeline occupancy etc.) — no schema,
+        no cross-mode equality contract."""
+        rec = {"type": "gauge", "name": name, "t": self.now(),
+               "thread": _thread_label(), **fields}
+        with self._lock:
+            self.gauges.append(rec)
+        self._write(rec)
+
+    # ------------------------------------------------------------ accessors
+    def find_spans(self, name: Optional[str] = None,
+                   round_idx: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s for s in self.spans
+                    if (name is None or s["name"] == name)
+                    and (round_idx is None or s["round"] == round_idx)]
+
+    def find_events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e for e in self.events
+                    if kind is None or e["kind"] == kind]
+
+    # -------------------------------------------------------------- summary
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase {count, total_s, p50_s, p95_s} over all recorded spans."""
+        by_name: Dict[str, List[float]] = {}
+        with self._lock:
+            for s in self.spans:
+                by_name.setdefault(s["name"], []).append(s["dur_s"])
+        out = {}
+        for name, durs in sorted(by_name.items()):
+            durs = sorted(durs)
+            out[name] = {
+                "count": len(durs),
+                "total_s": sum(durs),
+                "p50_s": durs[len(durs) // 2],
+                "p95_s": durs[min(len(durs) - 1, int(len(durs) * 0.95))],
+            }
+        return out
+
+    def summary_table(self) -> str:
+        """The --trace_summary human table."""
+        rows = [f"{'phase':<16} {'count':>6} {'total_s':>10} "
+                f"{'p50_ms':>9} {'p95_ms':>9}"]
+        for name, st in self.summary().items():
+            rows.append(f"{name:<16} {st['count']:>6d} {st['total_s']:>10.4f} "
+                        f"{st['p50_s'] * 1e3:>9.3f} {st['p95_s'] * 1e3:>9.3f}")
+        return "\n".join(rows)
+
+    # ---------------------------------------------------------------- close
+    def close(self) -> None:
+        if self._profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullTracer:
+    """Drop-everything tracer: the default when nothing is installed, so
+    instrumented call sites never branch on `tracer is None`."""
+
+    @contextmanager
+    def span(self, name, round_idx=None, **attrs):
+        yield _NULL_HANDLE
+
+    @contextmanager
+    def round(self, round_idx):
+        yield _NULL_HANDLE
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def event(self, kind, **fields):
+        pass
+
+    def gauge(self, name, **fields):
+        pass
+
+    def close(self):
+        pass
+
+
+class _NullSpanHandle:
+    def elapsed(self) -> float:
+        return 0.0
+
+
+_NULL_HANDLE = _NullSpanHandle()
+NULL_TRACER = NullTracer()
+
+
+def parse_profile_rounds(spec: str) -> tuple:
+    """'A:B' -> (A, B): profile rounds A..B-1 (half-open, like range)."""
+    try:
+        lo, hi = (int(p) for p in spec.split(":"))
+    except (ValueError, AttributeError) as e:
+        raise ValueError(
+            f"--profile_rounds wants 'A:B' (half-open round window), "
+            f"got {spec!r}") from e
+    if hi <= lo or lo < 0:
+        raise ValueError(f"--profile_rounds window {spec!r} is empty")
+    return lo, hi
+
+
+# ----------------------------------------------- installed-tracer seam
+_ACTIVE: List[Tracer] = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def install(tracer: Tracer) -> None:
+    """Make `tracer` the destination for module-level emit()/gauge() calls
+    (chaos, guard, mqtt, cache, prefetch). Stack discipline: the innermost
+    install wins; uninstall() pops."""
+    with _ACTIVE_LOCK:
+        _ACTIVE.append(tracer)
+
+
+def uninstall(tracer: Tracer) -> None:
+    with _ACTIVE_LOCK:
+        if tracer in _ACTIVE:
+            _ACTIVE.remove(tracer)
+
+
+def get_tracer() -> Optional[Tracer]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE[-1] if _ACTIVE else None
+
+
+def emit(kind: str, **fields) -> None:
+    """Event into the installed tracer; silent no-op when none is active."""
+    tracer = get_tracer()
+    if tracer is not None:
+        tracer.event(kind, **fields)
+
+
+def gauge(name: str, **fields) -> None:
+    """Gauge into the installed tracer; silent no-op when none is active."""
+    tracer = get_tracer()
+    if tracer is not None:
+        tracer.gauge(name, **fields)

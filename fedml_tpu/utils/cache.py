@@ -4,6 +4,33 @@ from __future__ import annotations
 
 import os
 
+# jax.monitoring listener registration is global and permanent — register
+# exactly once per process no matter how many runs enable the cache.
+_MONITORING_HOOKED = False
+
+
+def _hook_cache_monitoring() -> None:
+    """Forward jax's compilation-cache monitoring events (hits, misses,
+    writes) into the telemetry ledger as `compile_cache` events. No-op when
+    no tracer is installed; safe no-op on jax builds without the
+    monitoring API."""
+    global _MONITORING_HOOKED
+    if _MONITORING_HOOKED:
+        return
+    try:
+        import jax
+
+        def _forward(event: str, **kw) -> None:
+            if "cache" not in event:
+                return
+            from fedml_tpu import telemetry
+            telemetry.emit("compile_cache", name=event)
+
+        jax.monitoring.register_event_listener(_forward)
+        _MONITORING_HOOKED = True
+    except (ImportError, AttributeError):
+        pass
+
 
 def enable_compile_cache(min_compile_secs: float = 1.0,
                          cache_dir: str | None = None) -> bool:
@@ -30,4 +57,5 @@ def enable_compile_cache(min_compile_secs: float = 1.0,
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    _hook_cache_monitoring()
     return True

@@ -59,6 +59,8 @@ def save_checkpoint(ckpt_dir: str, step: int, state: dict[str, Any],
             os.remove(os.path.join(ckpt_dir, f"meta_{s}.json"))
         except OSError:
             pass
+    from fedml_tpu import telemetry
+    telemetry.emit("checkpoint_save", step=step, backend=backend)
     return path
 
 

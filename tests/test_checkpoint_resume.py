@@ -99,6 +99,48 @@ def test_crash_mid_save_falls_back_to_previous_step(ds8, tmp_path):
     assert _bitwise_equal(fresh.global_variables, api.global_variables)
 
 
+def test_crash_mid_flush_keeps_ledger_events_durable(ds8, tmp_path,
+                                                     monkeypatch):
+    """ISSUE 6 satellite: the pipelined loop defers metric flushes to its
+    sync points, so a crash inside the flush used to lose every already-
+    observed chaos injection. Ledger events are written to TRACE.jsonl the
+    moment they occur — a flush that dies must leave them all behind."""
+    from fedml_tpu.robustness.chaos import FaultPlan
+    from fedml_tpu.telemetry.records import RoundRecordLog
+    from fedml_tpu.telemetry.tracer import Tracer
+
+    path = str(tmp_path / "TRACE.jsonl")
+    tracer = Tracer(jsonl_path=path)
+
+    orig_flush = RoundRecordLog.flush
+
+    def boom(self, round_idx=None):
+        # round 0 flushes fine (0 % freq == 0 forces an early sync point);
+        # the deferred flush carrying rounds 1..3 dies mid-way
+        if round_idx == 3 and self._pending:
+            raise RuntimeError("simulated crash mid-flush")
+        return orig_flush(self, round_idx)
+
+    monkeypatch.setattr(RoundRecordLog, "flush", boom)
+    # freq=100 defers every flush after round 0 to the final round, by
+    # which point all four rounds' faults have been staged and injected
+    api = _api(ds8, _cfg(4, pipeline_depth=2, frequency_of_the_test=100))
+    with pytest.raises(RuntimeError, match="mid-flush"):
+        api.train(chaos=FaultPlan(seed=3, drop_rate=0.25, nan_rate=0.25),
+                  tracer=tracer)
+    tracer.close()
+
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    chaos_rounds = {ln["round"] for ln in lines
+                    if ln.get("kind") == "chaos_inject"}
+    assert chaos_rounds == {0, 1, 2, 3}      # every injection survived
+    committed = [ln["round"] for ln in lines
+                 if ln.get("kind") == "round_committed"]
+    assert committed == [0]                  # only the pre-crash sync point
+    assert [r["round"] for r in api.history] == [0]  # nothing half-committed
+
+
 def test_restored_tree_round_trips_dtypes(ds8, tmp_path):
     d = str(tmp_path / "ckpt")
     api = _api(ds8, _cfg(1))

@@ -306,6 +306,91 @@ def test_drive_loop_fetch_suppression_works():
     assert not _drive_findings(src)
 
 
+# ---------------------------------------------- naked-timer-in-drive-loop
+
+def _timer_findings(src, path="fedml_tpu/algorithms/fixture.py"):
+    return [f for f in lint_source(src, path)
+            if f.rule == "naked-timer-in-drive-loop"]
+
+
+def test_naked_timer_fires_on_time_time_pair_in_round_loop():
+    # the r01–r05 footgun: wall-clock around an async dispatch measures
+    # dispatch latency, not compute
+    src = (
+        "import time\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        t0 = time.time()\n"
+        "        m = self.round_fn(gv)\n"
+        "        rec['round_time'] = time.time() - t0\n")
+    findings = _timer_findings(src)
+    assert len(findings) == 2
+    assert all(f.rule == "naked-timer-in-drive-loop" for f in findings)
+
+
+def test_naked_timer_fires_on_perf_counter_in_while_loop():
+    src = (
+        "import time\n"
+        "def train(self):\n"
+        "    while r < n:\n"
+        "        t0 = time.perf_counter()\n"
+        "        self.round_fn(gv)\n")
+    assert _timer_findings(src)
+
+
+def test_naked_timer_blessed_by_block_until_ready():
+    # bracketing the timed region with a device sync makes the pair honest
+    src = (
+        "import time\n"
+        "import jax\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        t0 = time.perf_counter()\n"
+        "        m = self.round_fn(gv)\n"
+        "        jax.block_until_ready(m)\n"
+        "        dt = time.perf_counter() - t0\n")
+    assert not _timer_findings(src)
+
+
+def test_naked_timer_blessed_by_telemetry_span():
+    src = (
+        "import time\n"
+        "def train(self, tracer):\n"
+        "    for r in range(n):\n"
+        "        with tracer.span('dispatch', r):\n"
+        "            m = self.round_fn(gv)\n"
+        "        log_wall_clock(time.time())\n")
+    assert not _timer_findings(src)
+
+
+def test_naked_timer_clean_outside_loops():
+    src = (
+        "import time\n"
+        "def train(self):\n"
+        "    t0 = time.time()\n"
+        "    run()\n")
+    assert not _timer_findings(src)
+
+
+def test_naked_timer_scoped_to_algorithms_path():
+    src = (
+        "import time\n"
+        "def bench(self):\n"
+        "    for r in range(n):\n"
+        "        t0 = time.time()\n")
+    assert not _timer_findings(src, path="fedml_tpu/tools/fixture.py")
+
+
+def test_naked_timer_suppression_works():
+    src = (
+        "import time\n"
+        "def train(self):\n"
+        "    for r in range(n):\n"
+        "        # graft-lint: disable=naked-timer-in-drive-loop -- coarse ETA print only\n"
+        "        t0 = time.time()\n")
+    assert not _timer_findings(src)
+
+
 # ------------------------------------------------------------ partition rules
 
 def test_partition_coverage_fires_on_unmatched_leaf():

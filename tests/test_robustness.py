@@ -371,10 +371,10 @@ def test_guard_rolls_back_and_retries_with_fresh_rng(ds8):
     calls = []
     entry_vars = {}
 
-    def flaky(round_idx, faults=None, rng_salt=0):
+    def flaky(round_idx, faults=None, rng_salt=0, tracer=None):
         calls.append((round_idx, rng_salt))
         entry_vars[(round_idx, rng_salt)] = api.global_variables
-        m = orig(round_idx, faults=faults, rng_salt=rng_salt)
+        m = orig(round_idx, faults=faults, rng_salt=rng_salt, tracer=tracer)
         if round_idx == 1 and rng_salt == 0:
             m = dict(m)
             m["loss_sum"] = float("nan")  # simulate a diverged round

@@ -1,0 +1,325 @@
+"""graft-trace (ISSUE 6 tentpole): span/event/gauge tracer, the unified
+round-record path, and the perf-regression gate.
+
+The pins that matter:
+- spans nest and stay monotonic under an injected fake clock;
+- every event kind round-trips through the JSONL sink, and malformed emits
+  fail loudly at the call site (a ledger with silent holes is not a ledger);
+- eager and pipelined drives emit the SAME ledger event sequence for the
+  same seed (order-normalized) — telemetry must not observe the async
+  plumbing, only the round semantics;
+- a guard rollback leaves both the rollback event and the prefetch
+  invalidation gauge behind;
+- the perf gate trips with a readable diff and skips honestly on
+  incomparable environments;
+- a depth-2 chaos drive is >=95% span-covered and its ledger counters are
+  bit-equal to the history it committed.
+"""
+
+import json
+import os
+
+import pytest
+
+from fedml_tpu import telemetry
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.robustness.guard import GuardVerdict
+from fedml_tpu.telemetry.report import (
+    coverage,
+    fold,
+    load_trace,
+    newest_bench,
+    run_gate,
+)
+from fedml_tpu.telemetry.tracer import EVENT_SCHEMAS, Tracer
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _cfg(comm_round, **kw):
+    kw.setdefault("client_num_per_round", 8)
+    return FedConfig(dataset="mnist", model="lr", comm_round=comm_round,
+                     batch_size=8, lr=0.05, client_num_in_total=8,
+                     seed=0, **kw)
+
+
+def _api(ds, cfg):
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    return FedAvgAPI(ds, cfg, trainer)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ tracer core
+
+def test_span_nesting_and_monotonicity_with_fake_clock():
+    clock = _FakeClock()
+    t = Tracer(clock=clock)
+    with t.round(0):
+        clock.t += 1.0
+        with t.span("dispatch", 0) as h:
+            clock.t += 2.0
+            assert h.elapsed() == pytest.approx(2.0)  # queryable while open
+        clock.t += 0.5
+    inner, = t.find_spans("dispatch")
+    outer, = t.find_spans("round")
+    assert inner["dur_s"] == pytest.approx(2.0)
+    assert outer["dur_s"] == pytest.approx(3.5)
+    # the child lies strictly inside the parent interval
+    assert outer["t0"] <= inner["t0"]
+    assert inner["t0"] + inner["dur_s"] <= outer["t0"] + outer["dur_s"]
+    assert inner["thread"] == outer["thread"] == "main"
+
+
+def test_span_handle_elapsed_tracks_open_span():
+    clock = _FakeClock()
+    t = Tracer(clock=clock)
+    with t.span("round", 7) as h:
+        clock.t += 4.25
+        assert h.elapsed() == pytest.approx(4.25)
+
+
+_SAMPLE_EVENTS = {
+    "chaos_inject": dict(round=0, dropped=2, nan=1, corrupt=0),
+    "guard_verdict": dict(round=0, ok=True, reason=""),
+    "guard_rollback": dict(round=1, retry=1),
+    "guard_exhausted": dict(round=2),
+    "round_committed": dict(round=0, participated_count=6.0),
+    "checkpoint_save": dict(step=5),
+    "mqtt_reconnect": dict(client_id="c0", ok=True, attempts=2),
+    "compile_cache": dict(name="persistent_cache_hit"),
+    "round_fn_built": dict(program="engine.round", donate=True),
+}
+
+
+def test_every_event_kind_round_trips_through_jsonl(tmp_path):
+    assert set(_SAMPLE_EVENTS) == set(EVENT_SCHEMAS)  # keep the fixture total
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path)
+    for kind, fields in _SAMPLE_EVENTS.items():
+        t.event(kind, **fields)
+    t.close()
+    records = load_trace(path)
+    assert records[0]["type"] == "meta" and records[0]["version"] == 1
+    events = [r for r in records if r["type"] == "event"]
+    assert [e["kind"] for e in events] == list(_SAMPLE_EVENTS)
+    for e, (kind, fields) in zip(events, _SAMPLE_EVENTS.items()):
+        for k, v in fields.items():
+            assert e[k] == v
+        assert "t" in e and "thread" in e
+
+
+def test_event_schema_rejects_unknown_kind_and_missing_fields():
+    t = Tracer()
+    with pytest.raises(ValueError, match="unknown telemetry event kind"):
+        t.event("made_up_kind", round=0)
+    with pytest.raises(ValueError, match="missing required field"):
+        t.event("chaos_inject", round=0, dropped=1)  # nan, corrupt missing
+
+
+def test_events_are_flushed_to_jsonl_before_close(tmp_path):
+    """Satellite 6: ledger lines are durable the moment they occur — a crash
+    after emit cannot lose them."""
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path)
+    t.event("chaos_inject", round=3, dropped=1, nan=0, corrupt=0)
+    with open(path) as f:          # file read while the tracer is still open
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines[-1]["kind"] == "chaos_inject" and lines[-1]["round"] == 3
+    t.close()
+
+
+def test_emit_seam_routes_to_installed_tracer_and_noops_bare():
+    telemetry.emit("chaos_inject", round=0, dropped=0, nan=0, corrupt=0)  # no-op
+    t = Tracer()
+    telemetry.install(t)
+    try:
+        telemetry.emit("checkpoint_save", step=9)
+        telemetry.gauge("prefetch_occupancy", round=0, inflight=2)
+    finally:
+        telemetry.uninstall(t)
+    assert t.find_events("checkpoint_save")[0]["step"] == 9
+    assert t.gauges[0]["name"] == "prefetch_occupancy"
+    telemetry.emit("checkpoint_save", step=10)          # uninstalled again
+    assert len(t.find_events("checkpoint_save")) == 1
+
+
+def test_summary_table_has_p50_p95_columns():
+    clock = _FakeClock()
+    t = Tracer(clock=clock)
+    for _ in range(4):
+        with t.span("dispatch", 0):
+            clock.t += 0.25
+    table = t.summary_table()
+    head, dispatch_row = table.splitlines()[0], table.splitlines()[1]
+    for col in ("phase", "count", "total_s", "p50_ms", "p95_ms"):
+        assert col in head
+    assert dispatch_row.startswith("dispatch")
+    assert "250.000" in dispatch_row  # 0.25 s p50 in ms
+
+
+# ----------------------------------------------- drive-loop instrumentation
+
+def _ledger(tracer, kinds=("chaos_inject", "round_committed")):
+    """Order-normalized ledger: the cross-mode equality contract covers
+    round semantics, not wall-clock or which thread emitted."""
+    events = [{k: v for k, v in e.items() if k not in ("t", "thread")}
+              for e in tracer.events if e["kind"] in kinds]
+    return sorted(events, key=lambda e: (e["round"], e["kind"]))
+
+
+def test_eager_and_pipelined_emit_identical_event_sequences(ds8):
+    """Same seed, chaos on, guard off (guard retries re-stage cohorts, which
+    is legitimately asymmetric): the ledger must not be able to tell the
+    drive loops apart."""
+    plan = lambda: FaultPlan(seed=3, drop_rate=0.25, nan_rate=0.25)
+    te, tp = Tracer(), Tracer()
+    _api(ds8, _cfg(4)).train(chaos=plan(), tracer=te)
+    _api(ds8, _cfg(4, pipeline_depth=2)).train(chaos=plan(), tracer=tp)
+    assert _ledger(te) == _ledger(tp)
+    assert len(_ledger(te)) == 8  # one chaos_inject + one commit per round
+
+
+class _RejectOnce:
+    max_retries = 2
+
+    def __init__(self, bad_round=2):
+        self.bad_round = bad_round
+        self.fired = False
+
+    def inspect(self, round_idx, loss, global_variables=None):
+        if round_idx == self.bad_round and not self.fired:
+            self.fired = True
+            return GuardVerdict(False, "forced test rejection")
+        return GuardVerdict(True, "")
+
+
+def test_guard_rollback_emits_rollback_event_and_invalidate_gauge(ds8):
+    t = Tracer()
+    api = _api(ds8, _cfg(4, pipeline_depth=2))
+    api.train(guard=_RejectOnce(bad_round=2), tracer=t)
+
+    rollback, = t.find_events("guard_rollback")
+    assert rollback["round"] == 2 and rollback["retry"] == 1
+    verdicts = t.find_events("guard_verdict")
+    assert [v["ok"] for v in verdicts].count(False) == 1
+    assert {v["round"] for v in verdicts} == {0, 1, 2, 3}
+    # the rollback dropped the in-flight cohorts: the invalidation gauge
+    # recorded it (close() adds a final dropped=0 invalidation)
+    invals = [g for g in t.gauges if g["name"] == "prefetch_invalidate"]
+    assert any(g["dropped"] > 0 for g in invals)
+    # and every round still committed exactly once
+    assert [e["round"] for e in t.find_events("round_committed")] == [0, 1, 2, 3]
+
+
+def test_pipelined_occupancy_gauges_present(ds8):
+    t = Tracer()
+    _api(ds8, _cfg(4, pipeline_depth=2)).train(tracer=t)
+    occ = [g for g in t.gauges if g["name"] == "prefetch_occupancy"]
+    assert len(occ) == 4                      # one per consumed round
+    assert all(set(g) >= {"round", "inflight", "ahead_s", "miss"} for g in occ)
+    assert any(g["inflight"] > 0 for g in occ)  # the pipeline actually ran ahead
+
+
+def test_trace_jsonl_written_next_to_checkpoints(ds8, tmp_path):
+    """No tracer passed + ckpt_dir given -> the drive owns a tracer whose
+    JSONL sink lands next to the checkpoints."""
+    d = str(tmp_path / "ckpt")
+    _api(ds8, _cfg(2)).train(ckpt_dir=d)
+    records = load_trace(os.path.join(d, "TRACE.jsonl"))
+    assert records[0]["type"] == "meta"
+    kinds = {r["kind"] for r in records if r["type"] == "event"}
+    assert "round_committed" in kinds and "checkpoint_save" in kinds
+    assert {r["name"] for r in records if r["type"] == "span"} >= {
+        "round", "dispatch", "metrics_fetch", "checkpoint"}
+
+
+def test_depth2_chaos_coverage_and_ledger_matches_history(ds8):
+    """The acceptance pins: spans cover >=95% of round wall-clock on a
+    depth-2 chaos run, and the committed ledger's robustness counters are
+    bit-equal to the history records."""
+    t = Tracer()
+    api = _api(ds8, _cfg(4, pipeline_depth=2))
+    api.train(chaos=FaultPlan(seed=3, drop_rate=0.25, nan_rate=0.25),
+              tracer=t)
+
+    assert coverage(t.spans) >= 0.95
+    committed = {e["round"]: e for e in t.find_events("round_committed")}
+    assert sorted(committed) == [r["round"] for r in api.history]
+    for rec in api.history:
+        ev = committed[rec["round"]]
+        for key in ("participated_count", "quarantined_count",
+                    "chaos_dropped", "chaos_nan", "chaos_corrupt"):
+            assert ev[key] == rec[key], (key, ev, rec)
+
+
+# ------------------------------------------------------- fold + perf gate
+
+def test_fold_produces_bench_style_report(ds8, tmp_path):
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path, run_meta={"model": "lr", "platform": "cpu"})
+    _api(ds8, _cfg(3)).train(tracer=t)
+    t.close()
+    report = fold(load_trace(path))
+    assert report["metric"] == "fedavg_drive_rounds_per_sec"
+    assert report["rounds"] == 3 and report["value"] > 0
+    assert report["coverage"] >= 0.95
+    assert report["model"] == "lr" and report["platform"] == "cpu"
+    assert report["phases"]["dispatch"]["count"] == 3
+    assert report["events"]["round_committed"] == 3
+
+
+def test_perf_gate_trips_with_readable_diff():
+    report = {"value": 4.0, "platform": "cpu"}
+    bench = {"rounds_per_sec": 40.0, "platform": "cpu"}
+    ok, skipped, msg = run_gate(report, "/x/BENCH_r05.json", bench,
+                                tolerance=0.5)
+    assert not ok and not skipped
+    assert "FAIL" in msg and "BENCH_r05.json" in msg
+    assert "40.00" in msg and "4.00" in msg          # both sides of the diff
+    assert "0.10x" in msg and "floor 0.50x" in msg   # ratio vs tolerance
+    assert "host sync" in msg                        # actionable hint
+
+
+def test_perf_gate_passes_within_tolerance():
+    report = {"value": 30.0, "platform": "cpu"}
+    bench = {"rounds_per_sec": 40.0, "platform": "cpu"}
+    ok, skipped, msg = run_gate(report, "/x/BENCH_r05.json", bench,
+                                tolerance=0.5)
+    assert ok and not skipped and "PASS" in msg
+
+
+@pytest.mark.parametrize("key,bval,mval", [
+    ("platform", "tpu", "cpu"),
+    ("cpu_capped", False, True),
+    ("model", "cnn", "lr"),
+])
+def test_perf_gate_skips_on_environment_mismatch(key, bval, mval):
+    report = {"value": 0.001, key: mval}             # would fail if compared
+    bench = {"rounds_per_sec": 40.0, key: bval}
+    ok, skipped, msg = run_gate(report, "/x/BENCH_r06.json", bench)
+    assert ok and skipped and "SKIP" in msg and key in msg
+
+
+def test_newest_bench_prefers_highest_rnn_suffix(tmp_path):
+    for name, rps in (("BENCH_r03.json", 10.0), ("BENCH_r11.json", 20.0)):
+        with open(tmp_path / name, "w") as f:
+            json.dump({"parsed": {"rounds_per_sec": rps}}, f)
+    path, parsed = newest_bench(str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r11.json"
+    assert parsed["rounds_per_sec"] == 20.0

@@ -21,11 +21,18 @@ Env knobs:
                                  is real host work (FEMNIST png decode) and
                                  the overlap win is largest
   BENCH_PIPE_OUT=BENCH_r06.json  '' to skip writing the artifact
+  BENCH_PIPE_TRACE=/path.jsonl   write the eager (depth-0) arm's timed reps
+                                 as a TRACE.jsonl — the perf-regression
+                                 gate's input (tools/trace_report.py)
 
 Prints one JSON line; writes the BENCH_rXX-style artifact next to the repo
 root. On hosts without spare cores (nproc=1 CI boxes) staging and compute
 serialize on the same core, so the speedup honestly reads ~1.0x there —
 the JSON carries cpu_cores/cpu_capped so readers can tell.
+
+Timing comes from the telemetry tracer's `drive` span (graft-trace), not
+private perf_counter pairs, so BENCH and TRACE numbers can never disagree;
+each arm also reports its per-phase p50/p95 breakdown from the same spans.
 """
 
 from __future__ import annotations
@@ -34,7 +41,6 @@ import json
 import os
 import statistics
 import sys
-import time
 
 import numpy as np
 
@@ -46,6 +52,7 @@ enable_compile_cache()
 
 import jax  # noqa: E402
 
+from fedml_tpu import telemetry  # noqa: E402
 from fedml_tpu.algorithms.fedavg import FedAvgAPI  # noqa: E402
 from fedml_tpu.core.config import FedConfig  # noqa: E402
 from fedml_tpu.core.trainer import ClassificationTrainer  # noqa: E402
@@ -88,7 +95,9 @@ def _surrogate(clients: int, per_client: int, streaming: bool):
 
 
 def _run_arm(ds, depth: int, model: str, batch: int, rounds: int,
-             cpr: int, reps: int) -> tuple[float, list[float]]:
+             cpr: int, reps: int, trace_path: str | None = None,
+             run_meta: dict | None = None
+             ) -> tuple[float, list[float], dict]:
     cfg = FedConfig(dataset="femnist_surrogate", model=model,
                     comm_round=rounds, batch_size=batch, epochs=1, lr=0.1,
                     client_num_in_total=ds.client_num,
@@ -98,11 +107,21 @@ def _run_arm(ds, depth: int, model: str, batch: int, rounds: int,
     api = FedAvgAPI(ds, cfg, trainer)
     api.train()  # compile + warm (persistent cache makes this cheap)
     times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        api.train()
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times), times
+    phases = {}
+    for rep in range(reps):
+        # rep time = the tracer's `drive` span (the same monotonic interval
+        # the perf gate folds out of TRACE.jsonl); timed reps accumulate in
+        # one trace file, the warmup stays out of it
+        tracer = telemetry.Tracer(jsonl_path=trace_path,
+                                  mode="w" if rep == 0 else "a",
+                                  run_meta=run_meta)
+        api.train(tracer=tracer)
+        tracer.close()
+        times.append(sum(s["dur_s"] for s in tracer.find_spans("drive")))
+        phases = {name: {"p50_s": round(st["p50_s"], 6),
+                         "p95_s": round(st["p95_s"], 6)}
+                  for name, st in tracer.summary().items()}
+    return statistics.median(times), times, phases
 
 
 def main():
@@ -119,20 +138,32 @@ def main():
     if 0 not in depths:
         depths = [0] + depths
 
+    cores = os.cpu_count() or 1
+    trace_path = os.environ.get("BENCH_PIPE_TRACE") or None
+    run_meta = {
+        "model": model, "clients": clients, "clients_per_round": cpr,
+        "batch_size": batch, "platform": jax.devices()[0].platform,
+        "cpu_cores": cores,
+        "cpu_capped": jax.devices()[0].platform == "cpu" and cores < 2,
+    }
     arms = {}
     for depth in depths:
         # streaming stores carry LRU state — fresh store per arm
         ds = _surrogate(clients, per_client, streaming)
-        med, times = _run_arm(ds, depth, model, batch, rounds, cpr, reps)
+        med, times, phases = _run_arm(
+            ds, depth, model, batch, rounds, cpr, reps,
+            # the gate audits the eager arm (BENCH arms["0"] is its baseline)
+            trace_path=trace_path if depth == 0 else None,
+            run_meta=run_meta)
         arms[depth] = {"rounds_per_sec": round(rounds / med, 4),
                        "spread": {"min": round(rounds / max(times), 4),
                                   "max": round(rounds / min(times), 4),
-                                  "reps": reps}}
+                                  "reps": reps},
+                       "phases": phases}
     eager = arms[0]["rounds_per_sec"]
     best_depth = max((d for d in arms if d), default=0,
                      key=lambda d: arms[d]["rounds_per_sec"])
     speedup = arms[best_depth]["rounds_per_sec"] / eager if best_depth else 1.0
-    cores = os.cpu_count() or 1
     result = {
         "metric": "fedavg_drive_loop_pipeline_speedup",
         "value": round(speedup, 4),
