@@ -144,6 +144,32 @@ else
   echo "perf gate self-test: skipped (gate did not run against this baseline)"
 fi
 
+echo "== out-of-core scale smoke (50k-client mmap store, RSS budget gate)"
+# one bench_scale point over a sparse synthetic shard store: the drive loop
+# must stay inside a fixed RSS budget that a whole-store materialization
+# (~128MB of shards + copies on top of the ~250MB process floor) would blow
+python tools/bench_scale.py --point --clients 50000 --rounds 3 \
+  --rss_budget_mb 400 | tee /tmp/ci_scale_point.txt
+python - <<'EOF'
+import json
+line = [l for l in open("/tmp/ci_scale_point.txt") if l.startswith("{")][-1]
+p = json.loads(line)
+assert p["clients"] == 50000 and p["rounds_per_sec"] > 0, p
+assert not p["rss_budget_exceeded"], p
+assert p["store_physical_mb"] < p["store_logical_mb"] / 10, p  # sparse store
+print(f"OK scale point: rss={p['peak_rss_mb']}MB rps={p['rounds_per_sec']}")
+EOF
+
+echo "== scale RSS budget self-test: a 1MB budget must trip (exit 1)"
+if python tools/bench_scale.py --point --clients 2000 --rounds 1 \
+     --rss_budget_mb 1 >/tmp/ci_scale_trip.txt 2>&1; then
+  echo "scale RSS budget FAILED TO TRIP on a 1MB budget:"
+  cat /tmp/ci_scale_trip.txt
+  exit 1
+fi
+grep -q '"rss_budget_exceeded": true' /tmp/ci_scale_trip.txt
+echo "OK scale RSS budget trips"
+
 echo "== fedavg equivalence oracle: full-batch E=1 FedAvg == centralized"
 python - <<'EOF'
 # the reference CI's key trick (CI-script-fedavg.sh:44-50) as a direct check

@@ -404,6 +404,21 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
                                       aggregator, donate_data=donate_data)
 
 
+def stage_to_device(x, y, counts, participation=None) -> tuple:
+    """The stage_fn seam's device-commit step: one non-blocking
+    `jax.device_put` per cohort leaf, shared by the eager and pipelined
+    FedAvg staging paths (algorithms/fedavg.py `_stage_cohort`). Because
+    every data source — in-RAM PackedClients, StreamingPackedClients,
+    data.packed_store.MmapPackedStore — reaches the device through this
+    one call, swapping the backing store can never change staged bytes,
+    and the eager == pipelined bit-identity pin (tests/test_pipeline.py)
+    holds for all of them. Returns (x, y, counts, participation-or-None)
+    as committed device arrays."""
+    dx, dy, dc = jax.device_put(x), jax.device_put(y), jax.device_put(counts)
+    dp = jax.device_put(participation) if participation is not None else None
+    return dx, dy, dc, dp
+
+
 def build_chunked_round_runner(trainer, cfg: FedConfig, aggregator,
                                epoch_chunk: int) -> Callable:
     """An E-epoch local round as ceil(E/epoch_chunk) host dispatches of

@@ -25,8 +25,10 @@ from fedml_tpu.algorithms.engine import (
     build_eval_fn,
     build_federation_eval_fn,
     build_round_fn,
+    stage_to_device,
 )
 from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.packed_store import MmapPackedStore, materialize
 from fedml_tpu.data.packing import pack_eval_batches, pad_clients
 from fedml_tpu.data.prefetch import CohortPrefetcher, StagedCohort
 from fedml_tpu.data.registry import FederatedDataset
@@ -104,6 +106,13 @@ class FedAvgAPI(Checkpointable):
         self._fed_eval_fn = build_federation_eval_fn(model_trainer)
         self._resident_cache = None
         self.history: list[dict[str, Any]] = []
+        # The stage seam: every cohort — eager or pipelined, any backing
+        # store — reaches the device through this one callable
+        # (signature: stage_fn(round_idx, *, chaos=None, faults=None,
+        # tracer=None) -> StagedCohort). Injectable: multihost deployments
+        # swap in a sharded stager (parallel.multihost.sample_sharded_cohort
+        # + stage_local_cohort) that gathers only this host's slice.
+        self.stage_fn = self._stage_cohort
 
         rng = jax.random.PRNGKey(config.seed)
         example = jnp.asarray(dataset.train.x[:1, 0])
@@ -122,35 +131,25 @@ class FedAvgAPI(Checkpointable):
         `rng_salt` != 0 derives a fresh round rng (guard retries — salt 0
         keeps the legacy stream bit-exactly). Phase spans (stage/h2d/
         dispatch/metrics_fetch) bracket — never enter — the jitted call, so
-        an installed tracer changes no lowered program."""
+        an installed tracer changes no lowered program.
+
+        Staging goes through `self.stage_fn` — the SAME seam the pipelined
+        loop's prefetcher calls — so the eager and pipelined paths feed
+        `round_fn` byte-identical cohorts no matter which backing store
+        (PackedClients / StreamingPackedClients / MmapPackedStore) is
+        underneath."""
         cfg = self.cfg
         if tracer is None:
             tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
-        with tracer.span("stage", round_idx):
-            idx = client_sampling(round_idx, self.dataset.client_num, cfg.client_num_per_round)
-            x, y, counts = self.dataset.train.select(idx)
-            participation = None
-            if faults is not None:
-                x = apply_faults(faults, x)
-                participation = np.asarray(faults.participation, bool)
-            if self.mesh is not None:
-                n_before = counts.shape[0]
-                x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
-                if participation is not None and counts.shape[0] > n_before:
-                    # padded rows are zero-count no-ops either way; marking them
-                    # non-participating keeps participated_count honest
-                    participation = np.concatenate(
-                        [participation,
-                         np.zeros(counts.shape[0] - n_before, bool)])
-        with tracer.span("h2d", round_idx):
+        staged = self.stage_fn(round_idx, faults=faults, tracer=tracer)
+        with tracer.span("dispatch", round_idx):
             rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
             if rng_salt:
                 rng = jax.random.fold_in(rng, rng_salt)
-            args = [self.global_variables, self.agg_state, jnp.asarray(x),
-                    jnp.asarray(y), jnp.asarray(counts), rng]
-            if participation is not None:
-                args.append(jnp.asarray(participation))
-        with tracer.span("dispatch", round_idx):
+            args = [self.global_variables, self.agg_state, staged.x,
+                    staged.y, staged.counts, rng]
+            if staged.participation is not None:
+                args.append(staged.participation)
             self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
         with tracer.span("metrics_fetch", round_idx):
             # ONE host round trip for the whole metrics dict — per-key float()
@@ -276,21 +275,29 @@ class FedAvgAPI(Checkpointable):
                         self.save_checkpoint(ckpt_dir, round_idx + 1)
             round_idx += 1
 
-    # ------------------------------------------------------- pipelined train
-    def _stage_cohort(self, round_idx: int, chaos=None) -> StagedCohort:
+    # --------------------------------------------------------- stage seam
+    def _stage_cohort(self, round_idx: int, chaos=None, faults=None,
+                      tracer=None) -> StagedCohort:
         """Host half of one round as a pure function of `round_idx`: sample
         -> gather -> chaos faults + participation mask -> mesh pad ->
-        non-blocking `jax.device_put`. Runs on the prefetcher's staging
-        thread; mirrors `train_one_round`'s host path exactly (the
-        pipelined == eager bit-identity pin depends on it). Spans route
-        through the installed tracer (the stager thread has no tracer
-        argument) and are tagged thread="stager" when staged ahead."""
+        non-blocking `jax.device_put` (engine.stage_to_device). This is the
+        default `self.stage_fn` — the ONE staging path both drive loops
+        share: the eager loop calls it inline (train_one_round, with the
+        round's pre-computed `faults`), the pipelined loop calls it from
+        the prefetcher's staging thread (with the `chaos` plan, deriving
+        faults per round). Staging is pure in `round_idx`, so the two are
+        byte-identical — the pipelined == eager bit-identity pin depends
+        on it. Spans route through the installed tracer when none is
+        passed (the stager thread carries no tracer argument) and are
+        tagged thread="stager" when staged ahead."""
         cfg = self.cfg
-        tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
+        if tracer is None:
+            tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
         with tracer.span("stage", round_idx):
             idx = client_sampling(round_idx, self.dataset.client_num,
                                   cfg.client_num_per_round)
-            faults = chaos.events(round_idx, len(idx)) if chaos is not None else None
+            if faults is None and chaos is not None:
+                faults = chaos.events(round_idx, len(idx))
             x, y, counts = self.dataset.train.select(idx)
             participation = None
             if faults is not None:
@@ -300,13 +307,13 @@ class FedAvgAPI(Checkpointable):
                 n_before = counts.shape[0]
                 x, y, counts = pad_clients(x, y, counts, self.mesh.shape["clients"])
                 if participation is not None and counts.shape[0] > n_before:
+                    # padded rows are zero-count no-ops either way; marking them
+                    # non-participating keeps participated_count honest
                     participation = np.concatenate(
                         [participation,
                          np.zeros(counts.shape[0] - n_before, bool)])
         with tracer.span("h2d", round_idx):
-            dx, dy, dc = (jax.device_put(x), jax.device_put(y),
-                          jax.device_put(counts))
-            dp = jax.device_put(participation) if participation is not None else None
+            dx, dy, dc, dp = stage_to_device(x, y, counts, participation)
         return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
 
     def _train_pipelined(self, start_round, ckpt_dir, ckpt_every,
@@ -328,7 +335,7 @@ class FedAvgAPI(Checkpointable):
         rng, exactly like the eager loop."""
         cfg = self.cfg
         prefetcher = CohortPrefetcher(
-            lambda r: self._stage_cohort(r, chaos), depth=cfg.pipeline_depth)
+            lambda r: self.stage_fn(r, chaos=chaos), depth=cfg.pipeline_depth)
         self._last_prefetcher = prefetcher  # test/ops introspection
         # records (possibly holding device-array metrics) defer through the
         # shared RoundRecordLog; structured events (chaos, rollback) hit the
@@ -504,10 +511,16 @@ class FedAvgAPI(Checkpointable):
         if chunk is None:  # same chunk geometry as the streaming path
             chunk = min(self.dataset.client_num, 64)
         uniq = {id(p): p for _, p in splits}  # test may alias train
-        if not all(isinstance(p.x, np.ndarray) for p in uniq.values()):
+        if not all(isinstance(p.x, np.ndarray)
+                   or isinstance(p, MmapPackedStore)
+                   for p in uniq.values()):
             # StreamingPackedClients exposes x as a lazy decode facade with no
             # nbytes; staging it would eagerly decode the whole split, which
-            # is exactly what streaming exists to avoid — keep the chunked path
+            # is exactly what streaming exists to avoid — keep the chunked
+            # path. Mmap shard stores DO size themselves from the header
+            # (no data touched), so they fall through to the byte budget:
+            # in-budget stores materialize() once and share the in-RAM
+            # resident path bit-exactly, over-budget ones stay chunked.
             log.info("resident_eval disabled: streaming (lazy-decode) split — "
                      "using chunked eval")
             self._resident_cache = {}
@@ -528,6 +541,11 @@ class FedAvgAPI(Checkpointable):
             return None
 
         def stage(packed):
+            if isinstance(packed, MmapPackedStore):
+                # the ONE sanctioned whole-store read; in-budget (checked
+                # above) and bit-identical to an in-RAM split of the same rows
+                packed = materialize(packed,
+                                     budget=self.cfg.resident_eval_budget)
             nc = -(-packed.num_clients // chunk)
             x, y, counts = pad_clients(packed.x, packed.y, packed.counts, chunk)
             return tuple(

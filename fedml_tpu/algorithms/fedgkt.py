@@ -335,6 +335,7 @@ class FedGKTAPI(Checkpointable):
         r-1's server phase) — an interruption loses nothing (asserted by
         tests/test_split_vfl_secure.py::test_fedgkt_checkpoint_resume_exact)."""
         ds, cfg = self.dataset, self.cfg
+        # graft-lint: disable=full-store-materialize -- GKT trains EVERY client each cycle (no cohort sampling), so the whole eager CIFAR-scale train set staging device-resident is the algorithm's contract
         x = jnp.asarray(ds.train.x)
         y = jnp.asarray(ds.train.y)
         counts = jnp.asarray(ds.train.counts)

@@ -38,6 +38,13 @@ itself the `bare-suppression` finding):
   `time.time()`/`time.perf_counter()` reads inside a drive loop — async
   dispatch makes them measure the tunnel, not the device. Blessed: the
   telemetry Span API and `jax.block_until_ready`-bracketed timers.
+- `full-store-materialize`: `np.asarray(store.x)` / `np.stack(...)` /
+  `store.x[:]` whole-store reads over a packed/streaming client store —
+  the data plane's O(cohort) contract (data/packed_store.py) dies the
+  moment someone materializes `.x` wholesale. Blessed, call-graph-aware:
+  code inside a function named `materialize` or `__array__` (and the
+  closure of local helpers those call) is the one sanctioned whole-store
+  path. Bounded reads (`store.x[idx]`, `.x[:1, 0]`) are clean.
 """
 
 from __future__ import annotations
@@ -464,6 +471,127 @@ class _NakedTimer(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _first_index(sub: ast.Subscript):
+    """The leading index expression of `a[i, j, ...]` (or `a[i]`)."""
+    sl = sub.slice
+    if isinstance(sl, ast.Tuple):
+        return sl.elts[0] if sl.elts else None
+    return sl
+
+
+def _is_full_slice(node) -> bool:
+    """True for a bare `:` — the whole-first-axis read."""
+    return (isinstance(node, ast.Slice)
+            and node.lower is None and node.upper is None)
+
+
+def _blessed_store_ranges(col: _Collector) -> List[tuple]:
+    """(lineno, end_lineno) spans of the blessed whole-store readers:
+    functions named `materialize` or `__array__` plus the call-graph
+    closure of the local helpers they invoke (same propagation idea as
+    tracedness — a helper that materialize() delegates to is blessed
+    too)."""
+    frontier = [info for name in ("materialize", "__array__")
+                for info in col.by_name.get(name, [])]
+    blessed = set()
+    while frontier:
+        info = frontier.pop()
+        if id(info) in {id(b) for b in blessed}:
+            continue
+        blessed.add(info)
+        for callee in info.calls:
+            frontier.extend(col.by_name.get(callee, []))
+    return [(i.node.lineno, i.node.end_lineno or i.node.lineno)
+            for i in blessed]
+
+
+class _FullStoreMaterialize(ast.NodeVisitor):
+    """full-store-materialize: whole-store reads outside materialize().
+
+    Two triggers, one rule:
+    - a gather call (`np`/`onp`/`numpy`/`jnp` × `asarray`/`array`/`stack`)
+      whose argument contains a `.x` attribute that is bare or first-indexed
+      with a full `:` slice — `np.asarray(store.x)` copies EVERY client row
+      through the facade;
+    - any `<expr>.x[...]` subscript whose leading index is a full `:` —
+      `.x[:]` and `.x[:, :cap]` read the whole first axis no matter how the
+      rest is bounded.
+
+    Bounded first indices (`store.x[idx]`, `.x[k]`, `.x[:64]`) are the
+    select()-shaped access pattern and stay clean. Findings inside the
+    blessed ranges (functions named `materialize`/`__array__` and their
+    local-callee closure) are skipped — that is the ONE place a full read
+    is the point, and it enforces its own byte budget.
+    """
+
+    _GATHER_HEADS = _NP_ALIASES | {"jnp"}
+    _GATHER_TAILS = {"asarray", "array", "stack"}
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding],
+                 blessed_ranges: List[tuple]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self.blessed_ranges = blessed_ranges
+        self._flagged_lines: Set[int] = set()  # call-level finding emitted
+
+    def _blessed(self, lineno: int) -> bool:
+        return any(lo <= lineno <= hi for lo, hi in self.blessed_ranges)
+
+    def _emit(self, node, msg: str):
+        if self._blessed(node.lineno):
+            return
+        if node.lineno in self._flagged_lines:
+            return
+        if not is_suppressed(self.lines, node.lineno,
+                             "full-store-materialize"):
+            self._flagged_lines.add(node.lineno)
+            self.findings.append(Finding(
+                "full-store-materialize", f"{self.path}:{node.lineno}", msg))
+
+    def _is_gather(self, call: ast.Call) -> bool:
+        name = _dotted(call.func)
+        if not name or "." not in name:
+            return False
+        head, tail = name.split(".", 1)
+        return head in self._GATHER_HEADS and tail in self._GATHER_TAILS
+
+    @classmethod
+    def _whole_x_reads(cls, expr) -> List[ast.Attribute]:
+        """`.x` attributes in `expr` read without a bounding first index:
+        bare (`p.x`) or full-sliced (`p.x[:, ...]`)."""
+        bounded = set()
+        for sub in ast.walk(expr):
+            if (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "x"
+                    and not _is_full_slice(_first_index(sub))):
+                bounded.add(id(sub.value))
+        return [a for a in ast.walk(expr)
+                if isinstance(a, ast.Attribute) and a.attr == "x"
+                and id(a) not in bounded]
+
+    def visit_Call(self, node: ast.Call):
+        if self._is_gather(node):
+            exprs = list(node.args) + [k.value for k in node.keywords]
+            if any(self._whole_x_reads(e) for e in exprs):
+                self._emit(node,
+                           f"{_dotted(node.func)}() over a store's .x "
+                           "materializes every client row — select() the "
+                           "cohort, or route through the blessed "
+                           "materialize() helper")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if (isinstance(node.value, ast.Attribute) and node.value.attr == "x"
+                and _is_full_slice(_first_index(node))):
+            self._emit(node,
+                       ".x[:] reads the whole first axis of a store — "
+                       "index with the sampled cohort (store.x[idx]) or "
+                       "use materialize()")
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -480,6 +608,8 @@ def lint_source(source: str, path: str) -> List[Finding]:
         if info.traced:
             _RuleRunner(info, path, lines, findings).visit(info.node)
     _SyncIdiom(path, lines, findings).visit(tree)
+    _FullStoreMaterialize(path, lines, findings,
+                          _blessed_store_ranges(col)).visit(tree)
     # drive-loop fetch hygiene is an algorithms/-driver contract: that is
     # where the untraced round loops live (lint_tree hands us repo-relative
     # paths, so the scope survives any checkout location)

@@ -25,6 +25,10 @@ RULES = {
                                  "dispatch, not compute — use telemetry "
                                  "spans or block_until_ready-bracketed "
                                  "timers)",
+    "full-store-materialize": "np.asarray/np.stack/.x[:] whole-store read "
+                              "over a packed/streaming client store outside "
+                              "the blessed materialize() helper (stores are "
+                              "O(cohort) by contract — select() the cohort)",
     "partition-coverage": "param tree leaf matches no PartitionSpec rule",
     # HLO-layer rules (hlo_engine / comms): lowered-program collectives
     "collective-in-loop": "loop-invariant collective inside a while/scan body",

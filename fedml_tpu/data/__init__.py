@@ -1,3 +1,9 @@
+from fedml_tpu.data.packed_store import (
+    MmapPackedStore,
+    ShardWriter,
+    create_synthetic_store,
+    write_packed_shards,
+)
 from fedml_tpu.data.packing import PackedClients, pack_client_data, pack_eval_batches
 from fedml_tpu.data.prefetch import CohortPrefetcher, StagedCohort
 from fedml_tpu.data.registry import FederatedDataset, load_dataset, register_loader
@@ -11,4 +17,8 @@ __all__ = [
     "FederatedDataset",
     "load_dataset",
     "register_loader",
+    "MmapPackedStore",
+    "ShardWriter",
+    "create_synthetic_store",
+    "write_packed_shards",
 ]

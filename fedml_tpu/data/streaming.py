@@ -30,6 +30,8 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from fedml_tpu import telemetry
+
 log = logging.getLogger("fedml_tpu.data")
 
 
@@ -91,8 +93,10 @@ class StreamingPackedClients:
         self._sample_shape: tuple | None = None
         # the cohort prefetcher (data/prefetch.py) calls select() from its
         # staging thread while the drive loop may be evaluating on the main
-        # thread — the LRU OrderedDict + byte counter need one lock.
-        # Reentrant: select() pins rows through _client_row under the lock.
+        # thread — the LRU OrderedDict + byte counter need one lock. It
+        # guards ONLY cache lookup/insert/evict; decodes run unlocked so
+        # the two threads never serialize on codec work. Reentrant: the
+        # sample_shape lazy init may nest under a _client_row caller.
         self._lock = threading.RLock()
         # labels are cheap — hold the padded [C, n_max] array eagerly
         self.y = np.zeros((len(self._files), self._n_max), np.int32)
@@ -132,7 +136,11 @@ class StreamingPackedClients:
 
     def select(self, client_indices):
         """Gather a round's client rows — decodes at most the sampled
-        clients; everything else stays on disk."""
+        clients; everything else stays on disk. The lock is held only for
+        cache lookup/insert/evict, never across a decode: the PR-5 stager
+        thread and the main thread (eval chunks, guard re-stages) can
+        decode DIFFERENT clients concurrently instead of serializing every
+        round (tests/test_streaming.py::test_select_decodes_outside_lock)."""
         idx = np.asarray(client_indices)
         row_bytes = self._n_max * int(np.prod(self.sample_shape)) * 4
         need = len(idx) * row_bytes  # every sampled row is pinned at once
@@ -144,9 +152,16 @@ class StreamingPackedClients:
                 f"{self.byte_budget >> 20} MiB. Lower client_num_per_round / "
                 "image_size, cap samples per client (the ILSVRC2012 loader's "
                 "samples_per_client), or raise FEDML_TPU_STREAM_BUDGET.")
-        with self._lock:
-            x = np.stack([self._client_row(int(k), pin=set(idx.tolist()))
-                          for k in idx])
+        pin = set(int(k) for k in idx)
+        stats = {"hit": 0, "miss": 0}
+        x = np.stack([self._client_row(int(k), pin=pin, stats=stats)
+                      for k in idx])
+        telemetry.gauge("store_decode_hit", store="streaming",
+                        count=stats["hit"])
+        telemetry.gauge("store_decode_miss", store="streaming",
+                        count=stats["miss"])
+        telemetry.gauge("store_resident_bytes", store="streaming",
+                        bytes=self._resident_bytes)
         return x, self.y[idx], self.counts[idx]
 
     # ---- introspection (tests / ops) -------------------------------------
@@ -158,15 +173,38 @@ class StreamingPackedClients:
         return list(self._cache)
 
     # ---- internals --------------------------------------------------------
-    def _client_row(self, k: int, pin: set | None = None) -> np.ndarray:
+    def _client_row(self, k: int, pin: set | None = None,
+                    stats: dict | None = None) -> np.ndarray:
+        """One client's decoded [n_max, *sample] row. Lock granularity:
+        the lock brackets only the cache lookup and the insert/evict — the
+        decode itself runs unlocked, so concurrent callers decoding
+        different clients proceed in parallel. Two threads racing on the
+        SAME client may both decode it; the first insert wins and the loser
+        adopts the cached copy (decode is pure in k, so the bytes are
+        identical either way)."""
         with self._lock:
-            return self._client_row_locked(k, pin)
+            row = self._cache.get(k)
+            if row is not None:
+                self._cache.move_to_end(k)
+                if stats is not None:
+                    stats["hit"] += 1
+                return row
+        row = self._decode_row(k)  # EXPENSIVE — deliberately outside the lock
+        with self._lock:
+            existing = self._cache.get(k)
+            if existing is not None:  # lost a same-client race: keep the winner
+                self._cache.move_to_end(k)
+                if stats is not None:
+                    stats["hit"] += 1
+                return existing
+            if stats is not None:
+                stats["miss"] += 1
+            self._cache[k] = row
+            self._resident_bytes += row.nbytes
+            self._evict(pin or {k})
+        return row
 
-    def _client_row_locked(self, k: int, pin: set | None = None) -> np.ndarray:
-        row = self._cache.get(k)
-        if row is not None:
-            self._cache.move_to_end(k)
-            return row
+    def _decode_row(self, k: int) -> np.ndarray:
         files = self._files[k]
         shape = self.sample_shape
         row = np.zeros((self._n_max,) + shape, np.float32)
@@ -185,9 +223,6 @@ class StreamingPackedClients:
             if tuple(img.shape) != shape:
                 raise ValueError(f"decode_fn returned {img.shape}, expected {shape}")
             row[i] = img
-        self._cache[k] = row
-        self._resident_bytes += row.nbytes
-        self._evict(pin or {k})
         return row
 
     def _evict(self, pin: set):

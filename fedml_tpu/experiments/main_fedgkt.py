@@ -41,6 +41,7 @@ def main(argv=None):
         cap = args.client_sample_cap
         ds = dataclasses.replace(
             ds,
+            # graft-lint: disable=full-store-materialize -- GKT runs on eager CIFAR-scale PackedClients (all clients train every cycle); the cap re-pack is an intended one-shot whole-array copy
             train=PackedClients(ds.train.x[:, :cap], ds.train.y[:, :cap],
                                 np.minimum(ds.train.counts, cap)),
             test_global=(ds.test_global[0][:512], ds.test_global[1][:512]),

@@ -19,6 +19,7 @@ training script works from a laptop to a multi-host pod.
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass
 from typing import Any
 
 import jax
@@ -132,3 +133,99 @@ def round_barrier(name: str, round_idx: int):
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(f"{name}_{round_idx}")
+
+
+# --------------------------------------------------- sharded cohort sampling
+#
+# The million-client data plane (data/packed_store.py) makes per-host
+# staging O(cohort); this section makes it O(cohort / process_count).
+# Client sampling is a pure function of the round seed
+# (algorithms.fedavg.client_sampling), so every host can derive the FULL
+# cohort with zero communication — no broadcast, no leader — and then
+# gather/stage only its own contiguous block. The padded cohort partitions
+# exactly across hosts (tests/test_multihost.py "cohort" mode pins both
+# properties at 2 processes).
+
+
+@dataclass(frozen=True)
+class ShardedCohort:
+    """One round's cohort partitioned across hosts.
+
+    `full_idx` is the seed-derived global cohort — identical on every
+    host. `padded_idx` appends `-1` sentinel rows until the length is
+    `block * process_count` (block itself rounded up to `multiple`, the
+    per-host mesh size, so each host's slice feeds its local devices
+    evenly); sentinels stage as zero-count no-op clients, the same
+    weight-0 convention as data.packing.pad_clients."""
+
+    round_idx: int
+    full_idx: np.ndarray
+    padded_idx: np.ndarray
+    block: int
+    process_index: int
+    process_count: int
+
+    @property
+    def local_slice(self) -> slice:
+        return slice(self.process_index * self.block,
+                     (self.process_index + 1) * self.block)
+
+    @property
+    def local_idx(self) -> np.ndarray:
+        """This host's contiguous block of the padded cohort (-1 = pad)."""
+        return self.padded_idx[self.local_slice]
+
+
+def sample_sharded_cohort(round_idx: int, client_num_in_total: int,
+                          client_num_per_round: int, multiple: int = 1,
+                          process_index: int | None = None,
+                          process_count: int | None = None) -> ShardedCohort:
+    """Derive the round's cohort from the round seed and partition it
+    across hosts — deterministically, with no communication.
+
+    Every host runs the canonical `client_sampling` (same
+    `np.random.RandomState(round_idx)` stream as the single-host drive
+    loops, so a sharded deployment samples bit-identical cohorts), pads to
+    `block * process_count` where `block = ceil(n / P)` rounded up to
+    `multiple`, and owns the contiguous slice
+    `[process_index * block, (process_index + 1) * block)`. Topology
+    defaults to the live `jax.process_*` values; tests pass them
+    explicitly."""
+    # function-level import: algorithms.fedavg imports the parallel package
+    # for the shard_map backend, so the modules must not need each other at
+    # import time
+    from fedml_tpu.algorithms.fedavg import client_sampling
+
+    if multiple < 1:
+        raise ValueError(f"multiple must be >= 1, got {multiple}")
+    pc = jax.process_count() if process_count is None else int(process_count)
+    pi = jax.process_index() if process_index is None else int(process_index)
+    if not 0 <= pi < pc:
+        raise ValueError(f"process_index {pi} out of range [0, {pc})")
+    full_idx = np.asarray(
+        client_sampling(round_idx, client_num_in_total, client_num_per_round),
+        np.int64)
+    block = -(-len(full_idx) // pc)          # ceil(n / P)
+    block = -(-block // multiple) * multiple  # ... up to the mesh multiple
+    padded_idx = np.full(block * pc, -1, np.int64)
+    padded_idx[: len(full_idx)] = full_idx
+    return ShardedCohort(round_idx=round_idx, full_idx=full_idx,
+                         padded_idx=padded_idx, block=block,
+                         process_index=pi, process_count=pc)
+
+
+def stage_local_cohort(store, cohort: ShardedCohort):
+    """Gather ONLY this host's slice of the cohort from a PackedClients
+    duck-typed store (in-RAM, streaming, or data.packed_store mmap):
+    `select()` touches just the local real clients; `-1` sentinel rows
+    become zero-count padding. Returns host (x, y, counts) ready for
+    `engine.stage_to_device` / `make_array_from_process_local_data`."""
+    ids = cohort.local_idx
+    real = ids[ids >= 0]
+    x, y, counts = store.select(real)
+    pad = len(ids) - len(real)
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
+    return x, y, counts

@@ -257,13 +257,46 @@ class Tracer:
             }
         return out
 
+    def gauge_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-gauge-name {count, last, total} over all recorded gauges.
+        `last` is the latest record's payload (minus type/name/t/thread);
+        `total` sums each numeric payload field across records — e.g. the
+        store residency gauges (store_decode_hit / store_decode_miss /
+        store_resident_bytes, emitted per select() by the streaming and
+        mmap stores) fold into whole-drive hit/miss totals here."""
+        drop = {"type", "name", "t", "thread"}
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            gauges = list(self.gauges)
+        for g in gauges:
+            st = out.setdefault(g["name"], {"count": 0, "last": {},
+                                            "total": {}})
+            st["count"] += 1
+            payload = {k: v for k, v in g.items() if k not in drop}
+            st["last"] = payload
+            for k, v in payload.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    st["total"][k] = st["total"].get(k, 0) + v
+        return out
+
     def summary_table(self) -> str:
-        """The --trace_summary human table."""
+        """The --trace_summary human table: per-phase span percentiles,
+        then a gauges section (count + folded totals + last payload)."""
         rows = [f"{'phase':<16} {'count':>6} {'total_s':>10} "
                 f"{'p50_ms':>9} {'p95_ms':>9}"]
         for name, st in self.summary().items():
             rows.append(f"{name:<16} {st['count']:>6d} {st['total_s']:>10.4f} "
                         f"{st['p50_s'] * 1e3:>9.3f} {st['p95_s'] * 1e3:>9.3f}")
+        gauges = self.gauge_summary()
+        if gauges:
+            rows.append("")
+            rows.append(f"{'gauge':<24} {'count':>6}  totals / last")
+            for name, st in sorted(gauges.items()):
+                totals = " ".join(f"{k}={v}" for k, v in st["total"].items())
+                last = " ".join(f"{k}={v}" for k, v in st["last"].items()
+                                if k not in st["total"])
+                detail = "  ".join(p for p in (totals, last) if p)
+                rows.append(f"{name:<24} {st['count']:>6d}  {detail}")
         return "\n".join(rows)
 
     # ---------------------------------------------------------------- close

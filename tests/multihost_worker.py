@@ -10,7 +10,11 @@ mesh, and the node-per-device ppermute gossip ACROSS processes.
 
 Modes (argv[4]): "train" (default) — the full exercise; "defect" — this
 process exits immediately WITHOUT joining, so its peers must fail with a
-clean startup-timeout error instead of hanging (failure-detection test).
+clean startup-timeout error instead of hanging (failure-detection test);
+"cohort" — zero-communication sharded cohort sampling over a shared mmap
+shard store (argv[5] = store dir): every process must derive the same
+full cohort from the round seed, and the per-host slices must partition
+the padded cohort exactly (ISSUE 7 acceptance).
 """
 
 import os
@@ -49,6 +53,11 @@ def main():
     assert info["process_count"] == nproc, info
     assert info["global_device_count"] == 8, info
     assert info["local_device_count"] == n_local, info
+
+    if mode == "cohort":
+        _cohort_exercise(sys.argv[5], pid, nproc, n_local)
+        print(f"MULTIHOST_OK pid={pid}")
+        return
 
     # ---- control plane (DCN collectives replacing MPI messages)
     local = np.arange(4, dtype=np.int32) + (100 if pid == 0 else -7)
@@ -167,6 +176,75 @@ def main():
 
     round_barrier("test", 1)
     print(f"MULTIHOST_OK pid={pid}")
+
+
+def _cohort_exercise(store_dir: str, pid: int, nproc: int, n_local: int):
+    """Sharded cross-host sampling over a SHARED mmap shard store: (1) the
+    seed-derived full cohort is identical on every process with zero
+    communication; (2) the exchanged per-host slices reproduce the padded
+    cohort exactly (contiguous blocks, -1 pads as a suffix) and their real
+    entries partition the full cohort; (3) stage_local_cohort gathers
+    exactly this host's rows, with pad rows staged as zero-count no-ops.
+
+    Cross-process verification rides an atomic-rename file exchange, not an
+    XLA collective: zero-communication sampling is exactly the property
+    under test, and jitted multi-process collectives are unavailable on the
+    forced-CPU backend this test runs on."""
+    import time
+
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import client_sampling
+    from fedml_tpu.data.packed_store import MmapPackedStore
+    from fedml_tpu.parallel.multihost import (sample_sharded_cohort,
+                                              stage_local_cohort)
+
+    sync_dir = os.path.join(store_dir, "sync")
+    os.makedirs(sync_dir, exist_ok=True)
+
+    def exchange(tag: str, arr: np.ndarray) -> list:
+        tmp = os.path.join(sync_dir, f"{tag}_p{pid}.tmp.npy")
+        np.save(tmp, arr)  # np.save appends .npy when missing — keep it
+        os.rename(tmp, os.path.join(sync_dir, f"{tag}_p{pid}.npy"))
+        out, deadline = {}, time.time() + 120
+        while len(out) < nproc:
+            for p in range(nproc):
+                if p in out:
+                    continue
+                try:
+                    out[p] = np.load(os.path.join(sync_dir, f"{tag}_p{p}.npy"))
+                except FileNotFoundError:
+                    pass
+            if len(out) < nproc:
+                assert time.time() < deadline, f"peer never posted {tag}"
+                time.sleep(0.02)
+        return [out[p] for p in range(nproc)]
+
+    store = MmapPackedStore(store_dir)
+    total, per_round = store.num_clients, 64
+    for r in range(3):
+        cohort = sample_sharded_cohort(r, total, per_round, multiple=n_local)
+        # (1) deterministic: matches the single-host stream, same everywhere
+        want = np.asarray(client_sampling(r, total, per_round), np.int64)
+        assert np.array_equal(cohort.full_idx, want)
+        for peer_full in exchange(f"full{r}", cohort.full_idx):
+            assert np.array_equal(peer_full, cohort.full_idx)
+        # (2) the slices partition the padded cohort exactly
+        assert cohort.block % n_local == 0 and cohort.block * nproc >= per_round
+        gathered = np.concatenate(exchange(f"loc{r}", cohort.local_idx))
+        assert np.array_equal(gathered, cohort.padded_idx), (r, gathered)
+        real = gathered[gathered >= 0]
+        assert sorted(real.tolist()) == sorted(cohort.full_idx.tolist())
+        # (3) staging touches only the local block and pads with no-op rows
+        x, y, counts = stage_local_cohort(store, cohort)
+        assert x.shape[0] == y.shape[0] == counts.shape[0] == cohort.block
+        ids = cohort.local_idx
+        nreal = int((ids >= 0).sum())
+        fx, fy, fc = store.select(ids[ids >= 0])
+        assert np.array_equal(x[:nreal], fx) and np.array_equal(y[:nreal], fy)
+        assert np.array_equal(counts[:nreal], fc)
+        assert not counts[nreal:].any() and not x[nreal:].any()
+    store.close()
 
 
 if __name__ == "__main__":

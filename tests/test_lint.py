@@ -391,6 +391,88 @@ def test_naked_timer_suppression_works():
     assert not _timer_findings(src)
 
 
+# ----------------------------------------------------- full-store-materialize
+
+def _store_findings(src, path="fedml_tpu/algorithms/fixture.py"):
+    return [f for f in lint_source(src, path)
+            if f.rule == "full-store-materialize"]
+
+
+def test_full_store_fires_on_np_asarray_of_store_x():
+    src = (
+        "import numpy as np\n"
+        "def stage(store):\n"
+        "    return np.asarray(store.x)\n")
+    assert _store_findings(src)
+
+
+def test_full_store_fires_on_full_slice_even_with_bounded_rest():
+    # .x[:, :cap] bounds the SAMPLE axis but still reads every client row
+    src = (
+        "def cap_pack(ds, cap):\n"
+        "    return ds.train.x[:, :cap]\n")
+    f = _store_findings(src)
+    assert f and ".x[:]" in f[0].message
+
+
+def test_full_store_fires_on_jnp_stack_and_bare_slice():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def stage(store):\n"
+        "    a = jnp.stack([store.x[:]])\n")
+    # one finding per line even though both triggers match the same read
+    assert len(_store_findings(src)) == 1
+
+
+def test_full_store_bounded_reads_are_clean():
+    src = (
+        "import numpy as np\n"
+        "def stage(store, idx):\n"
+        "    probe = np.asarray(store.x[:1, 0])\n"
+        "    cohort = store.x[idx]\n"
+        "    one = store.x[3]\n"
+        "    head = store.x[:64]\n"
+        "    return np.asarray(cohort), one, head, probe\n")
+    assert not _store_findings(src)
+
+
+def test_full_store_blessed_inside_materialize_and_its_callees():
+    src = (
+        "import numpy as np\n"
+        "def _gather_all(store):\n"
+        "    return np.asarray(store.x)\n"
+        "def materialize(store):\n"
+        "    return _gather_all(store)\n")
+    assert not _store_findings(src)
+
+
+def test_full_store_helper_outside_blessed_closure_still_fires():
+    src = (
+        "import numpy as np\n"
+        "def sneaky(store):\n"
+        "    return np.asarray(store.x)\n"
+        "def materialize(store):\n"
+        "    return store.select(range(store.num_clients))\n")
+    assert _store_findings(src)
+
+
+def test_full_store_fires_outside_algorithms_paths_too():
+    src = (
+        "import numpy as np\n"
+        "def stage(store):\n"
+        "    return np.asarray(store.x)\n")
+    assert _store_findings(src, path="tools/fixture.py")
+
+
+def test_full_store_suppression_works():
+    src = (
+        "import numpy as np\n"
+        "def stage(store):\n"
+        "    # graft-lint: disable=full-store-materialize -- eager tiny fixture set\n"
+        "    return np.asarray(store.x)\n")
+    assert not _store_findings(src)
+
+
 # ------------------------------------------------------------ partition rules
 
 def test_partition_coverage_fires_on_unmatched_leaf():

@@ -18,7 +18,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_workers(nproc: int, mode: str = "train"):
+def _spawn_workers(nproc: int, mode: str = "train", extra: tuple = ()):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = dict(os.environ)
@@ -29,7 +29,8 @@ def _spawn_workers(nproc: int, mode: str = "train"):
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
     return [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), str(nproc), str(port), mode],
+            [sys.executable, worker, str(pid), str(nproc), str(port), mode,
+             *[str(a) for a in extra]],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
         for pid in range(nproc)
     ]
@@ -96,6 +97,32 @@ def test_distributed_round_n_processes(nproc):
     verdict asked for)."""
     procs = _spawn_workers(nproc)
     outs = _communicate(procs, timeout=420)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MULTIHOST_OK pid={pid}" in out, out
+
+
+def test_sharded_cohort_sampling_two_processes(tmp_path):
+    """ISSUE 7 acceptance: 2 real processes over ONE shared mmap shard
+    store derive the same seed-deterministic cohort with zero communication,
+    and their per-host slices partition it exactly (assertions live in
+    multihost_worker._cohort_exercise)."""
+    import numpy as np
+
+    from fedml_tpu.data.packed_store import write_packed_shards
+    from fedml_tpu.data.packing import PackedClients
+
+    rng = np.random.RandomState(0)
+    clients, n_max, dim = 500, 4, 6
+    packed = PackedClients(
+        rng.rand(clients, n_max, dim).astype(np.float32),
+        rng.randint(0, 3, size=(clients, n_max)).astype(np.int32),
+        rng.randint(1, n_max + 1, size=clients).astype(np.int64))
+    store_dir = str(tmp_path / "store")
+    write_packed_shards(store_dir, packed, clients_per_shard=128)
+
+    procs = _spawn_workers(2, mode="cohort", extra=(store_dir,))
+    outs = _communicate(procs, timeout=300)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"MULTIHOST_OK pid={pid}" in out, out

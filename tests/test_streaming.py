@@ -146,3 +146,54 @@ def test_streaming_eval_takes_chunked_path(tmp_path):
     assert api._resident_cache == {}  # streaming split marked ineligible
     for v in metrics.values():
         assert np.isfinite(v)
+
+
+def test_select_decodes_outside_lock():
+    """Lock-granularity regression (ISSUE 7 satellite): decode work must run
+    OUTSIDE the store lock. Two threads selecting disjoint clients through a
+    slow decoder must overlap their decodes — under the old
+    lock-held-across-decode code the observed concurrency is pinned at 1 and
+    the pipelined drive loop's staging thread serializes against eval."""
+    import threading
+    import time
+
+    dim, per_client = 6, 2
+    gate = threading.Lock()
+    live = {"now": 0, "max": 0}
+
+    def dec(path):
+        with gate:
+            live["now"] += 1
+            live["max"] = max(live["max"], live["now"])
+        time.sleep(0.15)  # decoders from both threads overlap this window
+        k, i = (int(s) for s in path.split("_")[1:])
+        with gate:
+            live["now"] -= 1
+        rs = np.random.RandomState(k * 100 + i)
+        return rs.rand(dim).astype(np.float32)
+
+    files = [[f"f_{k}_{i}" for i in range(per_client)] for k in range(8)]
+    labels = [np.arange(per_client) % 2 for _ in range(8)]
+    st = StreamingPackedClients(files, labels, dec, byte_budget=4 << 30)
+
+    out = {}
+
+    def worker(name, idx):
+        out[name] = st.select(np.asarray(idx))
+
+    threads = [threading.Thread(target=worker, args=("a", [0, 1, 2, 3])),
+               threading.Thread(target=worker, args=("b", [4, 5, 6, 7]))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert live["max"] >= 2, (
+        f"decoders never overlapped (max concurrency {live['max']}) — "
+        "select() is holding the store lock across decode again")
+    # decoded rows are still correct under the narrowed lock
+    for name, idx in (("a", [0, 1, 2, 3]), ("b", [4, 5, 6, 7])):
+        x, _, _ = out[name]
+        want = np.stack([
+            np.stack([dec(f"f_{k}_{i}") for i in range(per_client)])
+            for k in idx])
+        assert np.array_equal(x, want)

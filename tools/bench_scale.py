@@ -1,0 +1,199 @@
+"""Scale sweep for the out-of-core data plane: peak host RSS vs federation
+size with a FedAvg drive loop over an mmap-packed shard store.
+
+The claim under test (docs/PERF.md r12): staging is O(cohort), so peak host
+memory is FLAT in the number of clients — a 1M-client federation trains in
+the same RSS envelope as a 100k one, because `MmapPackedStore.select()`
+touches only the sampled rows and the shard files stay on disk. The store
+is synthetic-sparse (`create_synthetic_store` truncates the shard files to
+size without writing data — holes read as zeros), so building the 1M point
+costs seconds and near-zero disk, while the mmap/gather path exercised per
+round is byte-for-byte the production one.
+
+Each scale point runs in its OWN subprocess: `ru_maxrss` is a monotonic
+per-process high-water mark, so in-process sweeping would report every
+point at the largest point's peak. The driver re-invokes this file with
+`--point --clients N` and parses the JSON line the child prints.
+
+Env knobs:
+  BENCH_SCALE_POINTS=10000,100000,1000000   comma list of federation sizes
+  BENCH_SCALE_ROUNDS=5                      timed rounds per point
+  BENCH_SCALE_OUT=BENCH_SCALE_r01.json      '' to skip the artifact
+
+Point mode flags (what ci_smoke's scale smoke drives directly):
+  --point --clients N [--rounds R] [--rss_budget_mb M]
+`--rss_budget_mb` turns the point into a gate: exit 1 when the child's
+peak RSS exceeds the budget (the JSON line still prints, with
+`rss_budget_exceeded: true`, so the caller can say by how much).
+
+The artifact's `parsed` block deliberately has NO top-level
+`rounds_per_sec`/`arms` key: telemetry.report.baseline_rounds_per_sec must
+keep reading the drive-loop BENCH_rXX artifacts, never this RSS curve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# geometry: "lr" model over flat 32-f32 samples — staging-bound on purpose
+# (the point is the data plane, not the matmul)
+SHAPE, CLASSES, N_MAX, CPR, BATCH = (32,), 10, 20, 64, 20
+
+
+def _dir_physical_bytes(d: str) -> int:
+    """Bytes actually allocated on disk (sparse holes excluded)."""
+    total = 0
+    for fn in os.listdir(d):
+        st = os.stat(os.path.join(d, fn))
+        total += st.st_blocks * 512
+    return total
+
+
+def _dir_logical_bytes(d: str) -> int:
+    return sum(os.stat(os.path.join(d, fn)).st_size for fn in os.listdir(d))
+
+
+def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
+    import resource
+
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.data.packed_store import (MmapPackedStore,
+                                             create_synthetic_store)
+    from fedml_tpu.data.registry import FederatedDataset
+    from fedml_tpu.models.registry import create_model
+
+    store_dir = tempfile.mkdtemp(prefix=f"bench_scale_{clients}_")
+    try:
+        t0 = time.perf_counter()
+        create_synthetic_store(store_dir, clients, n_max=N_MAX,
+                               sample_shape=SHAPE)
+        build_s = time.perf_counter() - t0
+        store = MmapPackedStore(store_dir)
+
+        rng = np.random.RandomState(0)
+        gx = rng.rand(64, *SHAPE).astype(np.float32)
+        gy = rng.randint(0, CLASSES, size=64).astype(np.int32)
+        ds = FederatedDataset(name="scale_surrogate", train=store, test=None,
+                              train_global=(gx, gy), test_global=(gx, gy),
+                              class_num=CLASSES, meta={})
+        cfg = FedConfig(dataset="scale_surrogate", model="lr",
+                        comm_round=rounds, batch_size=BATCH, epochs=1, lr=0.1,
+                        client_num_in_total=clients, client_num_per_round=CPR,
+                        seed=0, ci=1, frequency_of_the_test=10**9)
+        trainer = ClassificationTrainer(create_model("lr", output_dim=CLASSES))
+        api = FedAvgAPI(ds, cfg, trainer)
+
+        api.train_one_round(0)  # compile + warm (outside the timed window)
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            # train_one_round's metrics_fetch is one blocking device_get, so
+            # each iteration measures completed work, not async dispatch
+            api.train_one_round(r + 1)
+        timed_s = time.perf_counter() - t0
+
+        peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+        result = {
+            "clients": clients,
+            "rounds": rounds,
+            "rounds_per_sec": round(rounds / timed_s, 4),
+            "peak_rss_mb": round(peak_rss_mb, 1),
+            "store_build_s": round(build_s, 3),
+            "store_logical_mb": round(_dir_logical_bytes(store_dir) / 2**20, 1),
+            "store_physical_mb": round(_dir_physical_bytes(store_dir) / 2**20, 1),
+            "platform": jax.devices()[0].platform,
+        }
+        rc = 0
+        if rss_budget_mb is not None:
+            result["rss_budget_mb"] = rss_budget_mb
+            result["rss_budget_exceeded"] = peak_rss_mb > rss_budget_mb
+            rc = 1 if result["rss_budget_exceeded"] else 0
+        store.close()
+        print(json.dumps(result))
+        return rc
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def run_sweep(rounds: int) -> None:
+    points = [int(s) for s in os.environ.get(
+        "BENCH_SCALE_POINTS", "10000,100000,1000000").split(",")]
+    results = []
+    for n in points:
+        cmd = [sys.executable, os.path.abspath(__file__), "--point",
+               "--clients", str(n), "--rounds", str(rounds)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        json_lines = [ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")]
+        if proc.returncode != 0 or not json_lines:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            raise SystemExit(
+                f"scale point clients={n} failed (rc={proc.returncode})")
+        results.append(json.loads(json_lines[-1]))
+
+    ratio = None
+    if len(results) >= 2:
+        ratio = round(results[-1]["peak_rss_mb"] / results[-2]["peak_rss_mb"], 4)
+
+    cores = os.cpu_count() or 1
+    parsed = {
+        "metric": "scale_rss_curve",
+        "unit": "MB peak RSS per federation size (flat curve = O(cohort) "
+                "staging)",
+        "points": results,
+        "rss_ratio_last_over_prev": ratio,
+        "rounds": rounds, "clients_per_round": CPR, "n_max": N_MAX,
+        "sample_shape": list(SHAPE), "model": "lr",
+        "platform": results[-1]["platform"] if results else "cpu",
+        "cpu_cores": cores,
+        "cpu_capped": cores < 2,
+    }
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_SCALE_OUT", "BENCH_SCALE_r01.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": len(results),
+                       "cmd": "python tools/bench_scale.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--point", action="store_true",
+                    help="run ONE scale point in this process and print its "
+                         "JSON line (the driver's subprocess mode)")
+    ap.add_argument("--clients", type=int, default=10000)
+    ap.add_argument("--rounds", type=int,
+                    default=int(os.environ.get("BENCH_SCALE_ROUNDS", 5)))
+    ap.add_argument("--rss_budget_mb", type=float, default=None)
+    args = ap.parse_args()
+    if args.point:
+        raise SystemExit(run_point(args.clients, args.rounds,
+                                   args.rss_budget_mb))
+    run_sweep(args.rounds)
+
+
+if __name__ == "__main__":
+    main()
