@@ -53,6 +53,26 @@ echo "== graft-lint HLO layer (collective traffic + memory vs COMMS_BUDGET.json)
 # tests/test_comms.py); COMMS.json is the machine report next to LINT.json
 python -m fedml_tpu.analysis --comms --fast --json COMMS.json
 
+echo "== comms budget self-test: a halved tensor-round ceiling must trip"
+# run one tensor program against a doctored budget table (real table with
+# the fednova bytes ceiling cut in half) — the gate must produce a
+# comms-budget finding, proving the new tensor.round entries are live
+python - <<'EOF'
+import json, tempfile, os
+from fedml_tpu.analysis.comms import run_comms
+name = "tensor.round[lr,f32,fednova,2x4]"
+budgets = json.load(open("COMMS_BUDGET.json"))
+budgets[name]["collective_bytes"] //= 2
+with tempfile.TemporaryDirectory() as d:
+    with open(os.path.join(d, "COMMS_BUDGET.json"), "w") as f:
+        json.dump(budgets, f)
+    report, _ = run_comms(d, targets=[name])
+assert not report.ok, "halved tensor budget failed to trip the comms gate"
+assert any(f.rule == "comms-budget" and f.target == name
+           for f in report.findings), report.findings
+print("OK comms budget trips on tensor.round regression")
+EOF
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 
@@ -76,6 +96,27 @@ with open(f"{sys.argv[1]}/wandb-summary.json") as f:
 for k in ("Test/Acc", "Test/Loss", "Train/Acc", "Train/Loss"):
     assert piped.get(k) == eager.get(k), (k, eager.get(k), piped.get(k))
 print("OK pipelined == eager:", {k: piped[k] for k in ("Test/Acc", "Test/Loss") if k in piped})
+EOF
+
+echo "== fedavg tensor-sharded smoke (2x4 clients x tensor mesh, CLI level)"
+# same workload as the eager smoke but with params tensor-sharded 4-way on
+# the forced 8-virtual-device mesh; tensor rounds are bit-identical to their
+# replicated twin (tests/test_tensor_shard.py) and match the vmap engine up
+# to client-psum reassociation, so the summary must agree to ~1e-5
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
+  --epochs 1 --batch_size 4 --pipeline_depth 0 --tensor_shards 4
+python - "$RUN_DIR" <<'EOF'
+import json, sys
+with open("/tmp/ci_smoke_eager_summary.json") as f:
+    eager = json.load(f)
+with open(f"{sys.argv[1]}/wandb-summary.json") as f:
+    sharded = json.load(f)
+for k in ("Test/Acc", "Test/Loss", "Train/Acc", "Train/Loss"):
+    d = abs(sharded.get(k, 1e9) - eager.get(k, -1e9))
+    assert d < 1e-5, (k, eager.get(k), sharded.get(k))
+print("OK tensor-sharded ~= eager:",
+      {k: sharded[k] for k in ("Test/Acc", "Test/Loss") if k in sharded})
 EOF
 
 echo "== fedavg chaos smoke (seeded drops + NaN faults, quarantine + guard)"

@@ -398,8 +398,23 @@ def build_round_fn_from_update(batched_update, aggregator,
 
 
 def build_round_fn(trainer, cfg: FedConfig, aggregator,
-                   donate_data: bool = False) -> Callable:
-    """Jitted synchronous round: vmap(local_update) + aggregate."""
+                   donate_data: bool = False,
+                   param_sharding=None) -> Callable:
+    """Jitted synchronous round: vmap(local_update) + aggregate.
+
+    `param_sharding` (a parallel.tensor.TensorSharding) switches the round
+    onto the 2D ('clients', 'tensor') mesh: params and aggregator state live
+    tensor-sharded between rounds, the client vmap step runs on gathered
+    params, and aggregation psums move 1/tensor_shards of the bytes. The
+    cohort axis and participation-mask semantics are unchanged.
+    """
+    if param_sharding is not None:
+        from fedml_tpu.parallel.tensor import build_tensor_round_fn
+
+        return build_tensor_round_fn(
+            trainer, cfg, aggregator, param_sharding,
+            donate_state=bool(cfg.extra.get("donate_params", False)),
+            donate_data=donate_data)
     return build_round_fn_from_update(_vmapped_update(trainer, cfg),
                                       aggregator, donate_data=donate_data)
 

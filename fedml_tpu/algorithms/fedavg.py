@@ -50,6 +50,51 @@ def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_rou
     return rng.choice(client_num_in_total, num, replace=False)
 
 
+def fast_client_sampling(round_idx: int, client_num_in_total: int,
+                         client_num_per_round: int) -> np.ndarray:
+    """O(cohort) uniform sampling without replacement: the first `num`
+    values of a seeded Feistel permutation of [0, N).
+
+    `rng.choice(N, num, replace=False)` above materialises and shuffles all
+    N ids — O(N) per round, the measured 1M-client bottleneck
+    (BENCH_SCALE_r01.json: 9.9 rounds/s vs 334.6 at 10k). A balanced
+    4-round Feistel network over the enclosing power-of-four domain is a
+    keyed bijection, so walking ids 0..num-1 through it (cycle-walking
+    values that land >= N back through the network, expected < 2 passes)
+    yields distinct in-range ids in O(num) work and memory. Keys derive
+    from RandomState(round_idx), so sampling stays a pure function of the
+    round index — but the permutation differs from `client_sampling`'s
+    shuffle, so this path is OPT-IN (--fast_sampling) to preserve seeded
+    trajectories by default.
+    """
+    n = int(client_num_in_total)
+    if n == client_num_per_round:
+        return np.arange(n)
+    num = min(client_num_per_round, n)
+    half_bits = max(1, (max(n - 1, 1).bit_length() + 1) // 2)
+    mask = np.uint64((1 << half_bits) - 1)
+    keys = np.random.RandomState(round_idx).randint(
+        0, 2 ** 63, size=4, dtype=np.int64).astype(np.uint64)
+
+    def permute(v: np.ndarray) -> np.ndarray:
+        left = (v >> np.uint64(half_bits)) & mask
+        right = v & mask
+        for k in keys:  # splitmix64-style round function, truncated to a half
+            mixed = right * np.uint64(0x9E3779B97F4A7C15) + k
+            mixed ^= mixed >> np.uint64(29)
+            mixed = mixed * np.uint64(0xBF58476D1CE4E5B9)
+            mixed ^= mixed >> np.uint64(32)
+            left, right = right, left ^ (mixed & mask)
+        return (left << np.uint64(half_bits)) | right
+
+    vals = permute(np.arange(num, dtype=np.uint64))
+    oob = vals >= n
+    while oob.any():
+        vals = np.where(oob, permute(vals), vals)
+        oob = vals >= n
+    return vals.astype(np.int64)
+
+
 class FedAvgAPI(Checkpointable):
     """Single-controller federated simulator.
 
@@ -70,13 +115,29 @@ class FedAvgAPI(Checkpointable):
         self.trainer = model_trainer
         self.aggregator = make_aggregator(aggregator_name, config)
         self.mesh = None
+        self._tensor_sharding = None
         if config.silo_threshold > 0 and config.backend == "shard_map":
             raise ValueError(
                 "silo_threshold (the single-chip silo-grouped conv path) "
                 "and backend='shard_map' are mutually exclusive — the "
                 "grouped lowering merges silos on ONE chip; drop one of the "
                 "two settings")
-        if config.backend == "shard_map":
+        if config.tensor_shards > 0:
+            if config.silo_threshold > 0 or config.backend == "shard_map":
+                raise ValueError(
+                    "tensor_shards already places rounds on its own 2D "
+                    "('clients', 'tensor') mesh — combine it with neither "
+                    "silo_threshold nor backend='shard_map'")
+            from fedml_tpu.parallel import TensorSharding, make_tensor_mesh
+
+            self.mesh = make_tensor_mesh(config.tensor_shards)
+            self._tensor_sharding = TensorSharding.for_model(
+                self.mesh, config.model)
+            self.round_fn = build_round_fn(
+                model_trainer, config, self.aggregator,
+                donate_data=config.pipeline_depth > 0,
+                param_sharding=self._tensor_sharding)
+        elif config.backend == "shard_map":
             from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
 
             # any mesh_shape flattens onto the 1-D clients axis; richer axes
@@ -118,6 +179,13 @@ class FedAvgAPI(Checkpointable):
         example = jnp.asarray(dataset.train.x[:1, 0])
         self.global_variables = model_trainer.init(rng, example)
         self.agg_state = self.aggregator.init_state(self.global_variables)
+        if self._tensor_sharding is not None:
+            # commit params + aggregator state to their tensor shards once;
+            # the round_fn keeps them sharded (and donated, when enabled)
+            # from then on
+            self.global_variables = self._tensor_sharding.place(
+                self.global_variables)
+            self.agg_state = self._tensor_sharding.place(self.agg_state)
 
         bs = config.batch_size if config.batch_size > 0 else 256
         self._test_batches = pack_eval_batches(*dataset.test_global, max(bs, 64))
@@ -294,8 +362,10 @@ class FedAvgAPI(Checkpointable):
         if tracer is None:
             tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
         with tracer.span("stage", round_idx):
-            idx = client_sampling(round_idx, self.dataset.client_num,
-                                  cfg.client_num_per_round)
+            sampler = (fast_client_sampling if cfg.fast_sampling
+                       else client_sampling)
+            idx = sampler(round_idx, self.dataset.client_num,
+                          cfg.client_num_per_round)
             if faults is None and chaos is not None:
                 faults = chaos.events(round_idx, len(idx))
             x, y, counts = self.dataset.train.select(idx)

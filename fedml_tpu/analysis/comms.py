@@ -1,8 +1,9 @@
 """The HLO-layer lintable surface + the COMMS_BUDGET.json gate.
 
-`PROGRAMS` names the repo's parallel round programs — the eight shard_map
+`PROGRAMS` names the repo's parallel round programs — the shard_map
 rounds (sharded.py's round per aggregator, hierarchical.py's two-axis
-round, gossip.py's ring mix, both sequence.py attention variants) plus two
+round, the four 2x4 tensor-sharded rounds of parallel/tensor.py,
+gossip.py's ring mix, both sequence.py attention variants) plus two
 single-chip extras (the engine round and the chunked chunk_fn) whose budget
 entries pin their collective count at ZERO: a collective ever appearing in
 the single-chip path is itself the regression. `--fast` skips the extras.
@@ -164,6 +165,48 @@ def _ulysses_attention():
     return fn, (s, s, s), None
 
 
+def _tensor_round(model_name: str, agg_name: str):
+    """A 2x4 ('clients', 'tensor') tensor-sharded round
+    (parallel/tensor.py): params + aggregator state enter sharded, the
+    round gathers per leaf at entry and slices before the client psums —
+    so the budget pins BOTH the all_gather cost of the gathered client
+    step and the 1/|tensor| aggregation traffic."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.parallel.tensor import (TensorSharding,
+                                           build_tensor_round_fn)
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(2, 4),
+                ("clients", "tensor"))
+    cfg = FedConfig(model=model_name, batch_size=2, epochs=1,
+                    dtype="float32", server_optimizer="adam", server_lr=0.01)
+    if model_name == "lr":
+        trainer = _lr_trainer()
+        gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+        data = (jax.ShapeDtypeStruct((2, 4, 32), jnp.float32),
+                jax.ShapeDtypeStruct((2, 4), jnp.int32))
+    else:
+        from fedml_tpu.core.trainer import NWPTrainer
+        from fedml_tpu.models.registry import create_model
+
+        trainer = NWPTrainer(create_model(model_name, output_dim=10))
+        gv, rng = _abstract_gv(trainer, (2, 16), jnp.int32)
+        data = (jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
+                jax.ShapeDtypeStruct((2, 4, 16), jnp.int32))
+    agg = make_aggregator(agg_name, cfg)
+    round_fn = build_tensor_round_fn(
+        trainer, cfg, agg, TensorSharding.for_model(mesh, model_name),
+        donate_state=True)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    args = (gv, agg_state) + data + (
+        jax.ShapeDtypeStruct((2,), jnp.int32), rng)
+    return round_fn, args, _tree_bytes(gv)
+
+
 def _engine_round():
     import jax
     import jax.numpy as jnp
@@ -210,9 +253,8 @@ def _chunked_chunk_fn():
     return runner.chunk_fn, args, _tree_bytes(gv)
 
 
-# target name -> (builder, num_devices the program spans). The eight
-# parallel round programs of ISSUE record; the two engine extras carry
-# zero-collective budget entries and are skipped by --fast.
+# target name -> (builder, num_devices the program spans); the two engine
+# extras carry zero-collective budget entries and are skipped by --fast.
 PROGRAMS: Dict[str, Tuple[Callable, int]] = {
     "sharded.round[lr,f32,fedavg]": (lambda: _sharded_round("fedavg"), N_DEV),
     "sharded.round[lr,f32,fedopt]": (lambda: _sharded_round("fedopt"), N_DEV),
@@ -220,6 +262,14 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
     "sharded.round[lr,f32,fednova]": (lambda: _sharded_round("fednova"),
                                       N_DEV),
     "hier.round[lr,f32,2x4]": (_hier_round, N_DEV),
+    "tensor.round[tformer,f32,fedavg,2x4]": (
+        lambda: _tensor_round("transformer_nwp", "fedavg"), N_DEV),
+    "tensor.round[tformer,f32,fedopt,2x4]": (
+        lambda: _tensor_round("transformer_nwp", "fedopt"), N_DEV),
+    "tensor.round[lr,f32,robust,2x4]": (
+        lambda: _tensor_round("lr", "robust"), N_DEV),
+    "tensor.round[lr,f32,fednova,2x4]": (
+        lambda: _tensor_round("lr", "fednova"), N_DEV),
     "gossip.mix[ring8]": (_gossip_mix, N_DEV),
     "sequence.ring[b1,t64,h8,d16]": (_ring_attention, N_DEV),
     "sequence.ulysses[b1,t64,h8,d16]": (_ulysses_attention, N_DEV),

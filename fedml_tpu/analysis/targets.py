@@ -251,6 +251,55 @@ def check_model_partitions() -> List[Finding]:
     return out
 
 
+def check_tensor_rule_coverage(rule_tables=None,
+                               family_models=None) -> List[Finding]:
+    """100% coverage over the RUNTIME partition-rule tables
+    (parallel/tensor.py RULE_TABLES) — the lint-only contract above,
+    extended to the tables that actually shard rounds.
+
+    Two directions: every non-scalar leaf of every family model must match
+    its family's table (an unmatched leaf would raise inside
+    `resolve_param_specs` at round-build time — catch it in lint instead),
+    and every rule must match at least one leaf across the family's models
+    (a dead rule means the table and the model zoo drifted apart).
+    `rule_tables`/`family_models` default to the runtime tables; tests
+    inject fixtures."""
+    import re
+
+    from fedml_tpu.analysis.partition import _flat_paths
+    from fedml_tpu.parallel.tensor import FAMILY_MODELS, RULE_TABLES
+
+    tables = RULE_TABLES if rule_tables is None else rule_tables
+    models = FAMILY_MODELS if family_models is None else family_models
+    out: List[Finding] = []
+    for family in sorted(tables):
+        rules = list(tables[family])
+        used = [False] * len(rules)
+        for name in models.get(family, ()):
+            if name not in available_models():
+                continue
+            shape, in_dtype, kw = MODEL_EXAMPLES[name]
+            module = create_model(name, output_dim=10, **kw)
+            tree = model_variable_shapes(module, shape, in_dtype)
+            out += check_partition_coverage(
+                tree, f"tensor-rules:{family}:{name}", rules=rules)
+            for path, leaf in _flat_paths(tree):
+                if getattr(leaf, "ndim", 0) == 0:
+                    continue
+                for i, (pattern, _) in enumerate(rules):
+                    if re.search(pattern, path):
+                        used[i] = True
+                        break
+        for hit, (pattern, spec) in zip(used, rules):
+            if not hit:
+                out.append(Finding(
+                    "partition-coverage", f"tensor-rules:{family}",
+                    f"rule {pattern!r} ({spec}) matches no leaf of any "
+                    f"family model — dead rule; prune it or fix the "
+                    f"pattern"))
+    return out
+
+
 def run_all(repo_root: str, include_models: bool = True,
             include_ast: bool = True) -> Report:
     """The full lint pass the CLI and tests/test_lint.py run."""
@@ -272,6 +321,8 @@ def run_all(repo_root: str, include_models: bool = True,
     report.mark("engine.round.retrace[lr]")
     report.extend(check_model_partitions())
     report.mark("partition-coverage[registry]")
+    report.extend(check_tensor_rule_coverage())
+    report.mark("partition-coverage[tensor-rules]")
     if include_ast:
         report.extend(lint_tree(repo_root, ["fedml_tpu", "tools"]))
         report.mark("ast[fedml_tpu,tools]")

@@ -98,6 +98,15 @@ class FedConfig:
     # vmap engine to numerical tolerance (tests/test_silo_grouped.py).
     silo_threshold: int = 0
     mesh_shape: tuple[int, ...] = ()
+    # >0 runs rounds on the 2D ('clients', 'tensor') mesh with params and
+    # aggregator state tensor-sharded per the model family's partition-rule
+    # table (parallel/tensor.py). Bit-identical in f32 to the replicated
+    # round (tests/test_tensor_shard.py); 0 = replicated params.
+    tensor_shards: int = 0
+    # Opt-in O(cohort) stateless cohort sampler (Feistel permutation over
+    # client ids). Default off: the default path keeps bit-compat with the
+    # seeded rng.choice trajectory of fedavg.client_sampling.
+    fast_sampling: bool = False
     dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models
 
     extra: dict[str, Any] = field(default_factory=dict, hash=False, compare=False)

@@ -71,6 +71,17 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--pipeline_depth", type=int, default=2,
                         help="cohort prefetch depth for the FedAvg-family "
                              "drive loop (0 = eager)")
+    # tensor-parallel rounds (fedml_tpu.parallel.tensor): params +
+    # aggregator state sharded per the model family's partition-rule table
+    # over a 2D ('clients', 'tensor') mesh; bit-identical in f32 to the
+    # replicated round
+    parser.add_argument("--tensor_shards", type=int, default=0,
+                        help="tensor-axis size of the 2D (clients, tensor) "
+                             "mesh (0 = replicated params)")
+    parser.add_argument("--fast_sampling", type=int, default=0,
+                        help="1 = O(cohort) Feistel-permutation cohort "
+                             "sampler (different seeded trajectory than the "
+                             "default O(N) sampler)")
     # graft-trace observability (fedml_tpu.telemetry): TRACE.jsonl is
     # always written to <run_dir>/TRACE.jsonl; these knobs add sinks
     parser.add_argument("--trace_summary", type=int, default=0,
@@ -147,6 +158,7 @@ def config_from_args(args) -> FedConfig:
         d["mesh_shape"] = tuple(d["mesh_shape"])
     else:
         d.pop("mesh_shape", None)
+    d["fast_sampling"] = bool(d.get("fast_sampling", 0))
     return FedConfig.from_dict(d)
 
 

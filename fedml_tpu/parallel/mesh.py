@@ -7,6 +7,8 @@ a `jax.sharding.Mesh`; axis names give the FL-parallelism taxonomy:
   clients — client/data parallelism (one client shard per device group)
   groups  — hierarchical FL outer axis (cloud -> group -> client)
   stages  — model-split axis (SplitNN pipeline analog)
+  tensor  — tensor/model parallelism (per-param partition rules,
+            parallel/tensor.py rule tables)
 """
 
 from __future__ import annotations
@@ -30,3 +32,17 @@ def make_mesh(shape: tuple[int, ...] | None = None, axis_names: tuple[str, ...] 
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     dev_mesh = mesh_utils.create_device_mesh(shape, devices=devices[:n])
     return Mesh(dev_mesh, axis_names)
+
+
+def make_tensor_mesh(tensor_shards: int) -> Mesh:
+    """2D ('clients', 'tensor') mesh: tensor-parallel groups nested in cohorts.
+
+    Uses every available device; the client axis absorbs whatever is left
+    after the tensor axis takes `tensor_shards` devices per group.
+    """
+    n_dev = len(jax.devices())
+    if tensor_shards < 1 or n_dev % tensor_shards != 0:
+        raise ValueError(
+            f"tensor_shards={tensor_shards} must divide device count {n_dev}"
+        )
+    return make_mesh((n_dev // tensor_shards, tensor_shards), ("clients", "tensor"))

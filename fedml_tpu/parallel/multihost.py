@@ -179,7 +179,8 @@ class ShardedCohort:
 def sample_sharded_cohort(round_idx: int, client_num_in_total: int,
                           client_num_per_round: int, multiple: int = 1,
                           process_index: int | None = None,
-                          process_count: int | None = None) -> ShardedCohort:
+                          process_count: int | None = None,
+                          sampler=None) -> ShardedCohort:
     """Derive the round's cohort from the round seed and partition it
     across hosts — deterministically, with no communication.
 
@@ -190,12 +191,16 @@ def sample_sharded_cohort(round_idx: int, client_num_in_total: int,
     `multiple`, and owns the contiguous slice
     `[process_index * block, (process_index + 1) * block)`. Topology
     defaults to the live `jax.process_*` values; tests pass them
-    explicitly."""
+    explicitly. `sampler` swaps the cohort-derivation function (e.g.
+    `fast_client_sampling` for the O(cohort) path) — any pure function of
+    (round_idx, N, num) keeps the no-communication property."""
     # function-level import: algorithms.fedavg imports the parallel package
     # for the shard_map backend, so the modules must not need each other at
     # import time
     from fedml_tpu.algorithms.fedavg import client_sampling
 
+    if sampler is None:
+        sampler = client_sampling
     if multiple < 1:
         raise ValueError(f"multiple must be >= 1, got {multiple}")
     pc = jax.process_count() if process_count is None else int(process_count)
@@ -203,7 +208,7 @@ def sample_sharded_cohort(round_idx: int, client_num_in_total: int,
     if not 0 <= pi < pc:
         raise ValueError(f"process_index {pi} out of range [0, {pc})")
     full_idx = np.asarray(
-        client_sampling(round_idx, client_num_in_total, client_num_per_round),
+        sampler(round_idx, client_num_in_total, client_num_per_round),
         np.int64)
     block = -(-len(full_idx) // pc)          # ceil(n / P)
     block = -(-block // multiple) * multiple  # ... up to the mesh multiple

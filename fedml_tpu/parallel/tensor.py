@@ -1,0 +1,431 @@
+"""Tensor-parallel federated rounds: regex partition rules on a 2D
+('clients', 'tensor') mesh.
+
+Promotes `analysis/partition.py::match_partition_rules` from the lint-only
+coverage contract (PR 3) to a runtime sharding subsystem: per-model-family
+rule tables below resolve a variables/opt-state tree into a PartitionSpec
+tree over `make_tensor_mesh`'s ('clients', 'tensor') mesh, and
+`build_tensor_round_fn` runs the federated round under pjit with the
+persistent state tensor-sharded and DONATED (old shards alias the new).
+Cohort sharding and the optional trailing participation mask are exactly
+the PR 4/5 contract — same key table, same quarantine staging, same
+all-dead no-op guard.
+
+What is sharded (v1):
+
+- the persistent state: global variables AND aggregator state (the FedOpt
+  server momenta are param-sized x2) live tensor-sharded between rounds —
+  per-device resident param bytes shrink by ~|tensor| (tools/
+  bench_tensor_shard.py -> BENCH_SHARD_r01.json);
+- the aggregation data path: client update stacks are sliced to the
+  device's tensor shard BEFORE the client-axis reductions, so the
+  weighted-mean partial sums, the psums that carry them, the FedOpt server
+  step and the FedNova recombine all move/compute 1/|tensor| of the bytes;
+- the client vmap step computes on gathered (full) params: the explicit
+  per-leaf `all_gather` at the round's entry and the `dynamic_slice` at
+  the aggregation boundary are the two layer-boundary resharding points —
+  the shard_map-manual analog of a `with_sharding_constraint` pair in
+  GSPMD-automatic pjit. Splitting the client-step matmuls themselves
+  (Megatron-style — the qkv/proj column/row rules below already encode
+  that layout) reassociates float contractions and is deliberately left
+  to a tolerance-gated follow-up: this path keeps bit-identity.
+
+Bit-identity contract: `all_gather`/`dynamic_slice` are pure data
+movement and slicing commutes exactly with every elementwise aggregation
+rule, so a tensor-sharded round is BIT-IDENTICAL in f32 to the replicated
+round on the same mesh (REPLICATED_RULES; pinned by
+tests/test_tensor_shard.py, fedavg/fedopt/robust/fednova, masked and
+unmasked). The same holds in bf16 on this path — no reduction is
+reassociated; only a future compute-split would introduce a documented
+tolerance. Versus the single-chip vmap engine the usual client-psum
+reassociation applies (<=1e-6, same as parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from fedml_tpu.analysis.partition import _flat_paths, match_partition_rules
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.utils.jax_compat import shard_map
+from fedml_tpu.utils.pytree import tree_where
+
+CLIENT_AXIS = "clients"
+TENSOR_AXIS = "tensor"
+
+# --------------------------------------------------------------- rule tables
+#
+# (path regex, spec) per model family; first match wins, scalars
+# auto-replicate, an UNMATCHED leaf raises — that is the coverage contract,
+# held at 100% over these tables by graft-lint's
+# partition-coverage[tensor-rules] rule (analysis/targets.py). Rules are
+# matched against opt-state trees too (optax paths embed the param path, so
+# `kernel$` covers `0/mu/block0/qkv/kernel`).
+
+# Megatron layout for the transformer blocks: qkv/mlp_up are
+# column-parallel (shard out-features = heads / ffn dim), proj/mlp_down are
+# row-parallel (shard in-features — the same heads / ffn dim), embeddings
+# and lm_head shard d_model. Norms and biases replicate.
+TRANSFORMER_PARTITION_RULES: List[Tuple[str, PS]] = [
+    (r"(tok_emb|pos_emb)/embedding$", PS(None, TENSOR_AXIS)),
+    (r"qkv/kernel$", PS(None, TENSOR_AXIS)),
+    (r"proj/kernel$", PS(TENSOR_AXIS, None)),
+    (r"mlp_up/kernel$", PS(None, TENSOR_AXIS)),
+    (r"mlp_down/kernel$", PS(TENSOR_AXIS, None)),
+    (r"lm_head/kernel$", PS(TENSOR_AXIS, None)),
+    (r"(bias|scale)$", PS()),
+]
+
+# LSTM gate kernels shard their out-features (the hidden dim), embeddings
+# shard the embedding dim, the vocab-sized output projections shard
+# out-features. 670-unit stackoverflow kernels are not divisible by small
+# tensor axes — resolve_param_specs demotes those leaves to replicated.
+RNN_PARTITION_RULES: List[Tuple[str, PS]] = [
+    (r"embeddings/embedding$", PS(None, TENSOR_AXIS)),
+    (r"OptimizedLSTMCell_\d+/[ih][ifgo]/kernel$", PS(None, TENSOR_AXIS)),
+    (r"fc\d?/kernel$", PS(None, TENSOR_AXIS)),
+    (r"(bias|scale)$", PS()),
+]
+
+# Fallback for the rest of the zoo (lr / mlp / cnn...): shard dense
+# in-features (dim 0 — always the large dim for classifier heads), keep
+# everything else replicated. Conv kernels ([kh, kw, cin, cout]) hit the
+# kernel rule on their tiny kh dim and get demoted to replicated — safe,
+# just not sharded.
+DEFAULT_TENSOR_RULES: List[Tuple[str, PS]] = [
+    (r"embedding$", PS(None, TENSOR_AXIS)),
+    (r"kernel$", PS(TENSOR_AXIS, None)),
+    (r"(bias|scale)$", PS()),
+    (r"(mean|var|count)$", PS()),
+]
+
+# every leaf replicated — the baseline arm of the bit-identity tests and
+# bench (same program, gathers and slices fold to no-ops)
+REPLICATED_RULES: List[Tuple[str, PS]] = [(r".", PS())]
+
+RULE_TABLES = {
+    "transformer": TRANSFORMER_PARTITION_RULES,
+    "rnn": RNN_PARTITION_RULES,
+}
+
+# registry models each family's table must cover at 100% (the lint pin)
+FAMILY_MODELS = {
+    "transformer": ("transformer_nwp",),
+    "rnn": ("rnn", "rnn_stackoverflow"),
+}
+
+
+def rules_for_model(model_name: str) -> List[Tuple[str, PS]]:
+    """Family rule table for a registry model name (prefix dispatch);
+    unknown families fall back to the generic dense table."""
+    if model_name.startswith("transformer"):
+        return TRANSFORMER_PARTITION_RULES
+    if model_name.startswith("rnn"):
+        return RNN_PARTITION_RULES
+    return DEFAULT_TENSOR_RULES
+
+
+# ---------------------------------------------------------- spec resolution
+
+def _tensor_dim(spec) -> Optional[int]:
+    """Index of the dim a spec shards over the tensor axis (None if the
+    leaf is replicated over it)."""
+    if not isinstance(spec, PS):
+        return None
+    for d, ax in enumerate(spec):
+        if ax == TENSOR_AXIS or (isinstance(ax, (tuple, list))
+                                 and TENSOR_AXIS in ax):
+            return d
+    return None
+
+
+def resolve_param_specs(rules: Sequence[Tuple[str, PS]], tree,
+                        tensor_shards: int):
+    """match_partition_rules + per-leaf divisibility demotion.
+
+    Returns (spec_tree, demoted) where `demoted` lists the paths whose
+    matched rule shards a dim not divisible by `tensor_shards` — those
+    leaves fall back to replicated (explicitly, here, instead of deep in a
+    device_put error). Raises ValueError on an unmatched leaf, same as the
+    lint contract."""
+    specs = match_partition_rules(rules, tree)
+    flat_leaves = _flat_paths(tree)
+    flat_specs = [s for _, s in _flat_paths(specs)]
+    demoted: List[str] = []
+    resolved = []
+    for (path, leaf), spec in zip(flat_leaves, flat_specs):
+        d = _tensor_dim(spec)
+        if d is not None and (d >= getattr(leaf, "ndim", 0)
+                              or leaf.shape[d] % tensor_shards):
+            demoted.append(path)
+            spec = PS()
+        resolved.append(spec)
+    spec_tree = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(tree), resolved)
+    return spec_tree, demoted
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSharding:
+    """The `param_sharding` seam: a ('clients', 'tensor') mesh plus the
+    rule table that places every persistent-state leaf on it. Passed to
+    `algorithms/engine.py::build_round_fn` to swap the single-chip vmap
+    round for the tensor-sharded one."""
+
+    mesh: Mesh
+    rules: Tuple[Tuple[str, PS], ...]
+
+    @classmethod
+    def for_model(cls, mesh: Mesh, model_name: str) -> "TensorSharding":
+        return cls(mesh, tuple(rules_for_model(model_name)))
+
+    @property
+    def tensor_shards(self) -> int:
+        return self.mesh.shape[TENSOR_AXIS]
+
+    def specs(self, tree):
+        return resolve_param_specs(self.rules, tree, self.tensor_shards)[0]
+
+    def shardings(self, tree):
+        specs = self.specs(tree)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                            is_leaf=lambda s: isinstance(s, PS))
+
+    def place(self, tree):
+        """Commit a host/replicated state tree to its tensor-sharded
+        layout (one device_put per leaf). The round donates these buffers
+        and returns identically-sharded ones."""
+        return jax.device_put(tree, self.shardings(tree))
+
+    def per_device_bytes(self, tree) -> Tuple[int, int]:
+        """(replicated_bytes, sharded_bytes) a single device holds for
+        `tree` — the BENCH_SHARD accounting, computable from specs alone."""
+        specs, _ = resolve_param_specs(self.rules, tree, self.tensor_shards)
+        flat = _flat_paths(tree)
+        flat_specs = [s for _, s in _flat_paths(specs)]
+        repl = shard = 0
+        for (_, leaf), spec in zip(flat, flat_specs):
+            nbytes = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            repl += nbytes
+            shard += nbytes // (self.tensor_shards
+                                if _tensor_dim(spec) is not None else 1)
+        return repl, shard
+
+
+# -------------------------------------------------- shard-local tree movers
+
+def _gather_tree(tree, specs):
+    """Reassemble full leaves from tensor shards (tiled all_gather on each
+    sharded leaf's dim) — the round-entry layer boundary."""
+    def gather(leaf, spec):
+        d = _tensor_dim(spec)
+        if d is None:
+            return leaf
+        return jax.lax.all_gather(leaf, TENSOR_AXIS, axis=d, tiled=True)
+
+    return jax.tree.map(gather, tree, specs,
+                        is_leaf=lambda x: isinstance(x, PS))
+
+
+def _slice_tree(tree, specs, tensor_shards: int, lead: int = 0):
+    """This device's tensor shard of full leaves (`lead` skips stacked
+    client axes) — the aggregation-boundary reshard. Pure dynamic_slice:
+    together with _gather_tree it is exact data movement, the root of the
+    bit-identity contract."""
+    tidx = jax.lax.axis_index(TENSOR_AXIS)
+
+    def one(leaf, spec):
+        d = _tensor_dim(spec)
+        if d is None:
+            return leaf
+        size = leaf.shape[d + lead] // tensor_shards
+        return jax.lax.dynamic_slice_in_dim(leaf, tidx * size, size,
+                                            axis=d + lead)
+
+    return jax.tree.map(one, tree, specs,
+                        is_leaf=lambda x: isinstance(x, PS))
+
+
+def _add_noise_sharded(aggregator, avg_shard, rng, full_params, specs_params,
+                       tensor_shards: int):
+    """RobustAggregator._add_noise with the SAME full-shape normal draws as
+    the replicated path, sliced to this device's shard — key-per-leaf and
+    draw shape unchanged, so sharded noise == replicated noise[shard]."""
+    noise_rng = jax.random.fold_in(rng, 7)
+    leaves, treedef = jax.tree.flatten(avg_shard["params"])
+    full_leaves = jax.tree.leaves(full_params)
+    spec_leaves = [s for _, s in _flat_paths(specs_params)]
+    keys = jax.random.split(noise_rng, len(leaves))
+    tidx = jax.lax.axis_index(TENSOR_AXIS)
+    noisy = []
+    for leaf, key, full, spec in zip(leaves, keys, full_leaves, spec_leaves):
+        noise = aggregator.cfg.stddev * jax.random.normal(
+            key, full.shape, leaf.dtype)
+        d = _tensor_dim(spec)
+        if d is not None:
+            size = full.shape[d] // tensor_shards
+            noise = jax.lax.dynamic_slice_in_dim(noise, tidx * size, size,
+                                                 axis=d)
+        noisy.append(leaf + noise)
+    out = dict(avg_shard)
+    out["params"] = jax.tree.unflatten(treedef, noisy)
+    return out
+
+
+def _aggregate_sharded(aggregator, gv_shard, gv_full, result, result_shard,
+                       weights, rng, agg_state, specs_gv, tensor_shards):
+    """Dispatch one aggregator over tensor-sharded client stacks.
+
+    fedavg/fedopt/fednova are elementwise over param dims, so their
+    existing `sharded` (clients-psum) rules run unchanged on shard-sized
+    trees — slicing commutes exactly. RobustAggregator's clip norm is a
+    reduction over the WHOLE tree, so the clip runs on the full stacks
+    (replicated over tensor — deterministic) and only the clipped result
+    is sliced into the mean; the DP noise slices the replicated full-shape
+    draw (see _add_noise_sharded)."""
+    from fedml_tpu.algorithms.aggregators import (RobustAggregator,
+                                                  tree_weighted_mean_psum)
+
+    if isinstance(aggregator, RobustAggregator):
+        clipped = aggregator._clipped(gv_full, result)
+        clipped_shard = _slice_tree(clipped, specs_gv, tensor_shards, lead=1)
+        avg = tree_weighted_mean_psum(clipped_shard, weights, CLIENT_AXIS)
+        avg = _add_noise_sharded(aggregator, avg, rng, gv_full["params"],
+                                 specs_gv["params"], tensor_shards)
+        return avg, agg_state
+    return aggregator.sharded(gv_shard, result_shard, weights, rng,
+                              agg_state, CLIENT_AXIS)
+
+
+# ------------------------------------------------------------ round builder
+
+def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
+                          sharding: TensorSharding,
+                          donate_state: bool = True,
+                          donate_data: bool = False) -> Callable:
+    """Jitted tensor-sharded round over sharding.mesh — the runtime the
+    rule tables exist for.
+
+    Same signature and semantics as engine.build_round_fn /
+    parallel.sharded.build_sharded_round_fn:
+    (gv, agg_state, x, y, counts, rng[, participation]) ->
+    (new_gv, new_agg_state, metrics), where gv/agg_state live
+    tensor-sharded (place them once with `sharding.place`; outputs come
+    back identically sharded). C must divide by mesh.shape['clients'];
+    the participation mask arms PR-4 fault tolerance bit-identically to
+    the replicated round (quarantine runs on the FULL stacks — a NaN in
+    any tensor shard quarantines the client everywhere).
+
+    `donate_state` (default ON — pjit donation of argnums (0, 1)) aliases
+    the old state shards into the new: between-round state costs ONE
+    sharded copy of params + opt state. Callers that snapshot live state
+    refs (the guard's rollback) must turn it off. `donate_data` matches
+    the engine's opt-in cohort-buffer donation for the pipelined loop.
+    """
+    from fedml_tpu.algorithms.aggregators import quarantine_stage
+    from fedml_tpu.algorithms.engine import build_local_update
+
+    mesh = sharding.mesh
+    n_cl = mesh.shape[CLIENT_AXIS]
+    t_sz = mesh.shape[TENSOR_AXIS]
+    local_update = build_local_update(trainer, cfg, pvary_axes=(CLIENT_AXIS,))
+
+    def specialize(specs_gv, specs_st, masked: bool):
+        def shard_body(gv_shard, st_shard, x, y, counts, rng,
+                       participation=None):
+            c_local = x.shape[0]
+            didx = jax.lax.axis_index(CLIENT_AXIS)
+            # same key table as the vmap engine / 1-D sharded round:
+            # split(rng, C)[d*c_local:(d+1)*c_local]
+            all_keys = jax.random.split(rng, c_local * n_cl)
+            crngs = jax.lax.dynamic_slice_in_dim(all_keys, didx * c_local,
+                                                 c_local)
+            gv_full = _gather_tree(gv_shard, specs_gv)
+            result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+                gv_full, x, y, counts, crngs)
+            weights = counts.astype(jnp.float32)
+            if participation is not None:
+                result, weights, alive, quarantined = quarantine_stage(
+                    result, weights, participation)
+            result_shard = result._replace(variables=_slice_tree(
+                result.variables, specs_gv, t_sz, lead=1))
+            new_gshard, new_st = _aggregate_sharded(
+                aggregator, gv_shard, gv_full, result, result_shard,
+                weights, rng, st_shard, specs_gv, t_sz)
+            metrics = {k: jax.lax.psum(v.sum(), CLIENT_AXIS)
+                       for k, v in result.metrics.items()}
+            if participation is None:
+                return new_gshard, new_st, metrics
+            alive_total = jax.lax.psum(alive.sum(), CLIENT_AXIS)
+            any_alive = alive_total > 0
+            new_gshard = tree_where(any_alive, new_gshard, gv_shard)
+            new_st = tree_where(any_alive, new_st, st_shard)
+            metrics["participated_count"] = alive_total.astype(jnp.float32)
+            metrics["quarantined_count"] = jax.lax.psum(
+                quarantined.sum(), CLIENT_AXIS).astype(jnp.float32)
+            return new_gshard, new_st, metrics
+
+        data_specs = (PS(CLIENT_AXIS), PS(CLIENT_AXIS), PS(CLIENT_AXIS))
+        in_specs = (specs_gv, specs_st) + data_specs + (PS(),)
+        if masked:
+            in_specs = in_specs + (PS(CLIENT_AXIS),)
+        fn = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(specs_gv, specs_st, PS()))
+        donate: Tuple[int, ...] = ()
+        if donate_state:
+            donate += (0, 1)
+        if donate_data:
+            donate += (2, 3, 4)
+        return jax.jit(fn, donate_argnums=donate) if donate else jax.jit(fn)
+
+    cache: dict = {}
+
+    def _specialized(global_variables, agg_state, masked: bool):
+        key = (jax.tree.structure(global_variables),
+               tuple(l.shape for l in jax.tree.leaves(global_variables)),
+               jax.tree.structure(agg_state),
+               tuple(l.shape for l in jax.tree.leaves(agg_state)),
+               masked)
+        jitted = cache.get(key)
+        if jitted is None:
+            specs_gv = sharding.specs(global_variables)
+            specs_st = sharding.specs(agg_state)
+            jitted = specialize(specs_gv, specs_st, masked)
+            cache[key] = jitted
+        return jitted
+
+    def round_fn(global_variables, agg_state, x, y, counts, rng,
+                 participation=None):
+        jitted = _specialized(global_variables, agg_state,
+                              participation is not None)
+        round_fn.jitted = jitted  # graft-lint donation introspection
+        args = (global_variables, agg_state, x, y, counts, rng)
+        if participation is not None:
+            args += (participation,)
+        # CPU can't alias some donated shapes — the fallback is a plain
+        # copy, so the per-compile warning is noise (engine.py idiom)
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*onat")
+            return jitted(*args)
+
+    def lower(*args):
+        """jax.jit-compatible lower — the HLO engine (analysis/comms.py)
+        lowers round programs from ShapeDtypeStructs without executing."""
+        return _specialized(args[0], args[1], len(args) > 6).lower(*args)
+
+    round_fn.lower = lower
+    round_fn.sharding = sharding
+    round_fn.donate_state = donate_state
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="tensor.round",
+                   donate=donate_state,
+                   mesh=f"{n_cl}x{t_sz}")
+    return round_fn

@@ -129,16 +129,28 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 # ------------------------------------------------------------------- gate
 
+# Bench families that are NOT drive-throughput baselines and must never be
+# picked up by the perf gate, whatever keys their schemas grow:
+# BENCH_SCALE_* record an RSS-vs-N curve at deliberately tiny round counts,
+# BENCH_SHARD_* record per-device param bytes on a forced 8-virtual-device
+# mesh. Both would poison the rounds/s comparison.
+_GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_")
+
+
 def newest_bench(root: str) -> Optional[Tuple[str, Dict[str, Any]]]:
     """(path, parsed) of the newest BENCH_*.json carrying a rounds/s
     number. 'Newest' is the rNN suffix when present (BENCH_r06 beats
-    BENCH_r01 regardless of mtime), mtime otherwise."""
+    BENCH_r01 regardless of mtime), mtime otherwise. Files from the
+    _GATE_SKIP_PREFIXES schemas are skipped by NAME, not by shape — a
+    schema that later grows a rounds_per_sec field stays excluded."""
     def order(path: str):
         m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
         return (1, int(m.group(1))) if m else (0, os.path.getmtime(path))
 
     for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json")),
                        key=order, reverse=True):
+        if os.path.basename(path).startswith(_GATE_SKIP_PREFIXES):
+            continue
         try:
             with open(path) as f:
                 parsed = json.load(f).get("parsed") or {}

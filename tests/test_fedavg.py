@@ -228,3 +228,41 @@ def test_resident_eval_budget_fallback(mnist10):
     m = api.local_test_on_all_clients(0)
     assert api._resident_cache == {}  # remembered as over-budget
     assert "Test/Acc" in m and "Train/Acc" in m
+
+
+# ------------------------------------------------- fast sampling (Feistel)
+
+def test_fast_sampling_is_a_permutation_sample():
+    from fedml_tpu.algorithms.fedavg import fast_client_sampling
+
+    idx = fast_client_sampling(7, 1_000_003, 64)
+    assert idx.shape == (64,)
+    assert idx.dtype == np.int64
+    assert len(set(idx.tolist())) == 64  # distinct
+    assert idx.min() >= 0 and idx.max() < 1_000_003  # in range
+
+
+def test_fast_sampling_deterministic_and_round_varying():
+    from fedml_tpu.algorithms.fedavg import fast_client_sampling
+
+    a = fast_client_sampling(3, 100, 10)
+    b = fast_client_sampling(3, 100, 10)
+    np.testing.assert_array_equal(a, b)
+    c = fast_client_sampling(4, 100, 10)
+    assert a.tolist() != c.tolist()
+
+
+def test_fast_sampling_covers_whole_population():
+    from fedml_tpu.algorithms.fedavg import fast_client_sampling
+
+    idx = fast_client_sampling(11, 37, 37)
+    assert sorted(idx.tolist()) == list(range(37))
+
+
+def test_default_sampler_bit_compat_pin(mnist10):
+    """fast_sampling defaults OFF: the staged cohort must keep coming from
+    the original rng.choice sampler so existing trajectories replay."""
+    api = make_api(mnist10, comm_round=1, client_num_per_round=4)
+    assert api.cfg.fast_sampling is False
+    expected = np.random.RandomState(5).choice(10, 4, replace=False)
+    np.testing.assert_array_equal(client_sampling(5, 10, 4), expected)

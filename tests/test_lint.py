@@ -531,3 +531,35 @@ def test_report_json_roundtrip(tmp_path):
     d = json.loads(p.read_text())
     assert d["ok"] is False and d["num_findings"] == 1
     assert d["findings"][0]["rule"] == "dead-cast"
+
+
+# ------------------------------------- tensor-rule coverage (runtime tables)
+
+def test_tensor_rule_coverage_repo_tables_clean():
+    from fedml_tpu.analysis.targets import check_tensor_rule_coverage
+
+    assert check_tensor_rule_coverage() == []
+
+
+def test_tensor_rule_coverage_unmatched_param_trips():
+    from fedml_tpu.analysis.targets import check_tensor_rule_coverage
+
+    # a table that only knows biases leaves every kernel/embedding unmatched
+    findings = check_tensor_rule_coverage(
+        rule_tables={"transformer": [(r"(bias|scale)$", PS())]},
+        family_models={"transformer": ("transformer_nwp",)})
+    assert findings, "kernels without a rule must trip the lint"
+    assert any("matches no PartitionSpec rule" in f.message for f in findings)
+
+
+def test_tensor_rule_coverage_dead_rule_trips():
+    from fedml_tpu.analysis.targets import check_tensor_rule_coverage
+    from fedml_tpu.parallel.tensor import TRANSFORMER_PARTITION_RULES
+
+    rules = [(r"no_such_layer_ever/kernel$", PS(None, "tensor"))]
+    rules += list(TRANSFORMER_PARTITION_RULES)
+    findings = check_tensor_rule_coverage(
+        rule_tables={"transformer": rules},
+        family_models={"transformer": ("transformer_nwp",)})
+    assert len(findings) == 1
+    assert "dead rule" in findings[0].message

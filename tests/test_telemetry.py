@@ -323,3 +323,24 @@ def test_newest_bench_prefers_highest_rnn_suffix(tmp_path):
     path, parsed = newest_bench(str(tmp_path))
     assert os.path.basename(path) == "BENCH_r11.json"
     assert parsed["rounds_per_sec"] == 20.0
+
+
+def test_newest_bench_skips_scale_schema_by_name(tmp_path):
+    """BENCH_SCALE_* is an RSS curve, never a throughput baseline — even if
+    its schema (maliciously) grows a rounds_per_sec key, the gate must skip
+    it by NAME and fall through to the real drive bench."""
+    with open(tmp_path / "BENCH_SCALE_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 12.5}}, f)
+    path, parsed = newest_bench(str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r02.json"
+    assert parsed["rounds_per_sec"] == 12.5
+
+
+def test_newest_bench_skips_shard_schema_by_name(tmp_path):
+    """BENCH_SHARD_* is a bytes table from a forced virtual mesh; with only
+    that artifact present the gate has NO baseline rather than a bogus one."""
+    with open(tmp_path / "BENCH_SHARD_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
+    assert newest_bench(str(tmp_path)) is None

@@ -1,0 +1,211 @@
+"""Tensor-parallel round tests on the forced 2x4 ('clients','tensor') mesh.
+
+The contract under test (parallel/tensor.py): a round whose params and
+aggregator state live tensor-sharded is BIT-IDENTICAL in f32 to the same
+round built with REPLICATED_RULES — the gather at round entry and the
+slice before the client psums are pure data movement, and slicing commutes
+exactly with every elementwise aggregation rule. Plus: spec resolution
+(divisibility demotion), per-device byte accounting, and the engine seam.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as PS
+
+from fedml_tpu.algorithms.aggregators import make_aggregator
+from fedml_tpu.algorithms.engine import build_round_fn
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer, NWPTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.parallel import TensorSharding, make_tensor_mesh
+from fedml_tpu.parallel.tensor import (
+    REPLICATED_RULES,
+    build_tensor_round_fn,
+    resolve_param_specs,
+    rules_for_model,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_tensor_mesh(4)
+
+
+@pytest.fixture(scope="module")
+def ds16():
+    return load_dataset("mnist", client_num_in_total=16,
+                        partition_method="homo", seed=1)
+
+
+def _lr_setup(ds16, agg_name):
+    cfg = FedConfig(batch_size=8, epochs=2, lr=0.05, client_num_in_total=16,
+                    client_num_per_round=16, server_optimizer="adam",
+                    server_lr=0.01)
+    trainer = ClassificationTrainer(
+        create_model("lr", output_dim=ds16.class_num))
+    agg = make_aggregator(agg_name, cfg)
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.asarray(ds16.train.x[:1, 0]))
+    state = agg.init_state(gv)
+    x, y, counts = ds16.train.select(np.arange(16))
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts))
+    return cfg, trainer, agg, gv, state, data, rng
+
+
+def _max_abs_delta(a, b):
+    d = jax.tree.map(lambda u, v: float(jnp.max(jnp.abs(u - v))), a, b)
+    return max(jax.tree.leaves(d), default=0.0)
+
+
+@pytest.mark.parametrize("agg_name,masked", [
+    ("fedavg", False), ("fedopt", False), ("robust", False),
+    ("fednova", False), ("fedavg", True), ("robust", True),
+])
+def test_tensor_round_bit_identical_to_replicated(mesh24, ds16, agg_name,
+                                                  masked):
+    cfg, trainer, agg, gv, state, (x, y, counts), rng = _lr_setup(
+        ds16, agg_name)
+    part = (jnp.asarray(np.array([True] * 12 + [False] * 4))
+            if masked else None)
+
+    sh = TensorSharding.for_model(mesh24, "lr")
+    sh_repl = TensorSharding(mesh24, tuple(REPLICATED_RULES))
+    rf_sh = build_tensor_round_fn(trainer, cfg, agg, sh, donate_state=False)
+    rf_re = build_tensor_round_fn(trainer, cfg, agg, sh_repl,
+                                  donate_state=False)
+
+    g1, s1, m1 = rf_sh(sh.place(gv), sh.place(state), x, y, counts, rng, part)
+    g2, s2, m2 = rf_re(sh_repl.place(gv), sh_repl.place(state), x, y, counts,
+                       rng, part)
+    # fedavg/fedopt/fednova aggregate elementwise, so slicing commutes with
+    # every reduction and the arms match BITWISE. Robust's clip norm spans
+    # the whole tree; GSPMD may re-partition that reduction across the
+    # tensor axis, reassociating the sum — one-ulp-scale slack only.
+    tol = 1e-8 if agg_name == "robust" else 0.0
+    assert _max_abs_delta(g1, g2) <= tol, "variables diverged"
+    assert _max_abs_delta(s1, s2) <= tol, "aggregator state diverged"
+    for k in m1:
+        assert abs(float(m1[k]) - float(m2[k])) <= tol * 100
+    # outputs really are tensor-sharded (donation-compatible placement)
+    spec_leaves = [s.spec for s in jax.tree.leaves(
+        jax.tree.map(lambda l: l.sharding, g1))]
+    assert any("tensor" in str(s) for s in spec_leaves), \
+        "no output leaf carries a tensor-axis sharding"
+
+
+def test_tensor_round_matches_vmap_engine(mesh24, ds16):
+    """Versus the single-chip engine only the client-psum reassociation
+    applies — same tolerance as the 1-D sharded round."""
+    cfg, trainer, agg, gv, state, (x, y, counts), rng = _lr_setup(
+        ds16, "fedavg")
+    sh = TensorSharding.for_model(mesh24, "lr")
+    rf = build_tensor_round_fn(trainer, cfg, agg, sh, donate_state=False)
+    vmap_rf = build_round_fn(trainer, cfg, agg)
+
+    g1, _, m1 = rf(sh.place(gv), sh.place(state), x, y, counts, rng)
+    g2, _, m2 = vmap_rf(gv, state, x, y, counts, rng)
+    assert _max_abs_delta(g1, g2) < 1e-6
+    for k in m1:
+        assert abs(float(m1[k]) - float(m2[k])) < 1e-3
+
+
+def test_rnn_family_round_bit_identical(mesh24):
+    """The rnn rule table drives a real LSTM round: sharded == replicated."""
+    cfg = FedConfig(model="rnn", batch_size=4, epochs=1, lr=0.1,
+                    client_num_in_total=2, client_num_per_round=2)
+    trainer = NWPTrainer(create_model("rnn", output_dim=90, vocab_size=90))
+    agg = make_aggregator("fedavg", cfg)
+    rng = jax.random.PRNGKey(3)
+    gv = trainer.init(rng, jnp.zeros((2, 16), jnp.int32))
+    state = agg.init_state(gv)
+    nprng = np.random.RandomState(0)
+    x = jnp.asarray(nprng.randint(1, 90, (2, 8, 16)), jnp.int32)
+    y = jnp.asarray(nprng.randint(1, 90, (2, 8)), jnp.int32)  # last-pos logits
+    counts = jnp.full((2,), 8, jnp.int32)
+
+    sh = TensorSharding.for_model(mesh24, "rnn")
+    sh_repl = TensorSharding(mesh24, tuple(REPLICATED_RULES))
+    rf = build_tensor_round_fn(trainer, cfg, agg, sh, donate_state=False)
+    rf_re = build_tensor_round_fn(trainer, cfg, agg, sh_repl,
+                                  donate_state=False)
+    g1, _, _ = rf(sh.place(gv), sh.place(state), x, y, counts, rng)
+    g2, _, _ = rf_re(sh_repl.place(gv), sh_repl.place(state), x, y, counts,
+                     rng)
+    assert _max_abs_delta(g1, g2) == 0.0
+
+
+def test_transformer_specs_shrink_per_device_bytes(mesh24):
+    """The transformer rule table must shrink per-device param bytes by
+    >= 1.9x at tensor=4 (the BENCH_SHARD acceptance floor) — checked from
+    specs alone, no compile."""
+    m = create_model("transformer_nwp", output_dim=10004)
+    gv = jax.eval_shape(lambda: m.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((2, 16), jnp.int32), train=False))
+    sh = TensorSharding.for_model(mesh24, "transformer_nwp")
+    repl, shard = sh.per_device_bytes(gv)
+    assert repl / shard >= 1.9, f"shrink {repl / shard:.2f}x < 1.9x"
+
+
+def test_divisibility_demotion_falls_back_to_replicated():
+    tree = {"params": {"head": {"kernel": np.zeros((10, 7), np.float32)},
+                       "body": {"kernel": np.zeros((8, 3), np.float32)}}}
+    rules = [(r"kernel$", PS("tensor", None))]
+    specs, demoted = resolve_param_specs(rules, tree, tensor_shards=4)
+    # 10 % 4 != 0 -> demoted; 8 % 4 == 0 -> sharded
+    assert demoted == ["params/head/kernel"]
+    assert specs["params"]["head"]["kernel"] == PS()
+    assert specs["params"]["body"]["kernel"] == PS("tensor", None)
+
+
+def test_unmatched_param_raises():
+    tree = {"params": {"mystery": np.zeros((4, 4), np.float32)}}
+    with pytest.raises(ValueError, match="partition rule not found"):
+        resolve_param_specs(rules_for_model("transformer_nwp"), tree, 4)
+
+
+def test_engine_seam_routes_param_sharding(mesh24, ds16):
+    """build_round_fn(param_sharding=...) must return the tensor round,
+    with state donation keyed off cfg.extra['donate_params']."""
+    cfg, trainer, agg, gv, state, (x, y, counts), rng = _lr_setup(
+        ds16, "fedavg")
+    sh = TensorSharding.for_model(mesh24, "lr")
+    rf = build_round_fn(trainer, cfg, agg, param_sharding=sh)
+    assert rf.sharding is sh and rf.donate_state is False
+
+    cfg2 = cfg.replace(extra={"donate_params": True})
+    rf2 = build_round_fn(trainer, cfg2, agg, param_sharding=sh)
+    assert rf2.donate_state is True
+    g, s, m = rf2(sh.place(gv), sh.place(state), x, y, counts, rng)
+    assert np.isfinite(float(m["loss_sum"]))
+
+
+def test_api_tensor_shards_trains_and_keeps_state_sharded(ds16):
+    cfg = FedConfig(comm_round=3, batch_size=16, lr=0.1,
+                    client_num_in_total=16, client_num_per_round=10,
+                    tensor_shards=4)
+    trainer = ClassificationTrainer(
+        create_model("lr", output_dim=ds16.class_num))
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    api = FedAvgAPI(ds16, cfg, trainer)
+    hist = api.train()
+    assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+    kernel = api.global_variables["params"]["linear"]["kernel"]
+    assert "tensor" in str(kernel.sharding.spec)
+
+
+def test_tensor_shards_conflicts_raise(ds16):
+    trainer = ClassificationTrainer(
+        create_model("lr", output_dim=ds16.class_num))
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    for bad in (dict(backend="shard_map"), dict(silo_threshold=8)):
+        cfg = FedConfig(client_num_in_total=16, client_num_per_round=16,
+                        tensor_shards=4, **bad)
+        with pytest.raises(ValueError, match="tensor_shards"):
+            FedAvgAPI(ds16, cfg, trainer)

@@ -19,6 +19,8 @@ Env knobs:
   BENCH_SCALE_POINTS=10000,100000,1000000   comma list of federation sizes
   BENCH_SCALE_ROUNDS=5                      timed rounds per point
   BENCH_SCALE_OUT=BENCH_SCALE_r01.json      '' to skip the artifact
+  BENCH_SCALE_FAST=1                        --fast_sampling in every point
+                                            (the O(cohort) Feistel sampler)
 
 Point mode flags (what ci_smoke's scale smoke drives directly):
   --point --clients N [--rounds R] [--rss_budget_mb M]
@@ -64,7 +66,8 @@ def _dir_logical_bytes(d: str) -> int:
     return sum(os.stat(os.path.join(d, fn)).st_size for fn in os.listdir(d))
 
 
-def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
+def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
+              fast_sampling: bool = False) -> int:
     import resource
 
     from fedml_tpu.utils.cache import enable_compile_cache
@@ -98,7 +101,8 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
         cfg = FedConfig(dataset="scale_surrogate", model="lr",
                         comm_round=rounds, batch_size=BATCH, epochs=1, lr=0.1,
                         client_num_in_total=clients, client_num_per_round=CPR,
-                        seed=0, ci=1, frequency_of_the_test=10**9)
+                        seed=0, ci=1, frequency_of_the_test=10**9,
+                        fast_sampling=fast_sampling)
         trainer = ClassificationTrainer(create_model("lr", output_dim=CLASSES))
         api = FedAvgAPI(ds, cfg, trainer)
 
@@ -120,6 +124,7 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
             "store_logical_mb": round(_dir_logical_bytes(store_dir) / 2**20, 1),
             "store_physical_mb": round(_dir_physical_bytes(store_dir) / 2**20, 1),
             "platform": jax.devices()[0].platform,
+            "fast_sampling": fast_sampling,
         }
         rc = 0
         if rss_budget_mb is not None:
@@ -136,10 +141,13 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
 def run_sweep(rounds: int) -> None:
     points = [int(s) for s in os.environ.get(
         "BENCH_SCALE_POINTS", "10000,100000,1000000").split(",")]
+    fast = bool(int(os.environ.get("BENCH_SCALE_FAST", "0")))
     results = []
     for n in points:
         cmd = [sys.executable, os.path.abspath(__file__), "--point",
                "--clients", str(n), "--rounds", str(rounds)]
+        if fast:
+            cmd.append("--fast_sampling")
         proc = subprocess.run(cmd, capture_output=True, text=True)
         json_lines = [ln for ln in proc.stdout.splitlines()
                       if ln.startswith("{")]
@@ -163,6 +171,7 @@ def run_sweep(rounds: int) -> None:
         "rounds": rounds, "clients_per_round": CPR, "n_max": N_MAX,
         "sample_shape": list(SHAPE), "model": "lr",
         "platform": results[-1]["platform"] if results else "cpu",
+        "fast_sampling": fast,
         "cpu_cores": cores,
         "cpu_capped": cores < 2,
     }
@@ -188,10 +197,13 @@ def main():
     ap.add_argument("--rounds", type=int,
                     default=int(os.environ.get("BENCH_SCALE_ROUNDS", 5)))
     ap.add_argument("--rss_budget_mb", type=float, default=None)
+    ap.add_argument("--fast_sampling", action="store_true",
+                    help="sample cohorts with the O(cohort) Feistel "
+                         "sampler instead of the O(N) default")
     args = ap.parse_args()
     if args.point:
         raise SystemExit(run_point(args.clients, args.rounds,
-                                   args.rss_budget_mb))
+                                   args.rss_budget_mb, args.fast_sampling))
     run_sweep(args.rounds)
 
 

@@ -1,0 +1,166 @@
+"""Tensor-parallel rounds bench: per-device param bytes + step time,
+replicated vs tensor-sharded, on the forced 8-virtual-device 2x4 mesh.
+
+The claim under test (ROADMAP item 2): params no longer need to fit one
+chip. A round built with the model family's partition-rule table
+(`parallel/tensor.py`) keeps the persistent state — global variables AND
+the FedOpt server momenta — tensor-sharded between rounds, so the bytes a
+single device holds shrink by ~|tensor| while the round stays
+bit-identical in f32 (tests/test_tensor_shard.py). This bench places both
+arms and reports MEASURED per-device bytes (summed over the device's
+addressable shards — not a spec-math estimate) plus wall-clock step time.
+
+The mesh is 8 virtual CPU devices (2 clients x 4 tensor) sharing one
+host's memory and cores, so step times say nothing about real 8-chip
+latency — `cpu_capped` is set whenever the mesh is virtual and readers
+must treat timing rows as shape-only. The BYTES columns are exact on any
+backend: sharding layouts are backend-independent.
+
+Artifact: BENCH_SHARD_r01.json, same envelope as the scale bench
+({n, cmd, rc, tail, parsed}). The parsed block deliberately carries NO
+rounds_per_sec/arms keys, and telemetry.report skips BENCH_SHARD_* by
+name — this is a bytes table, not a drive-throughput baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TENSOR_SHARDS = 4
+VOCAB = 10004  # stackoverflow-scale vocab: embeddings dominate, like the
+               # federated fine-tuning workloads the sharding exists for
+TIMED_STEPS = 3
+
+
+def _device_bytes(tree) -> int:
+    """MAX over devices of the bytes that device actually holds (sum of
+    its addressable shard buffers) — the HBM-resident figure a real chip
+    would need."""
+    import jax
+
+    per_dev: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        for shard in leaf.addressable_shards:
+            per_dev[shard.device] = (per_dev.get(shard.device, 0)
+                                     + shard.data.nbytes)
+    return max(per_dev.values())
+
+
+def bench_model(model_name: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import NWPTrainer
+    from fedml_tpu.models.registry import create_model
+    from fedml_tpu.parallel import TensorSharding, make_tensor_mesh
+    from fedml_tpu.parallel.tensor import (REPLICATED_RULES,
+                                           build_tensor_round_fn,
+                                           resolve_param_specs,
+                                           rules_for_model)
+
+    mesh = make_tensor_mesh(TENSOR_SHARDS)
+    n_cl = mesh.shape["clients"]
+    cfg = FedConfig(model=model_name, batch_size=2, epochs=1, lr=0.05,
+                    server_optimizer="adam", server_lr=0.001,
+                    client_num_in_total=n_cl, client_num_per_round=n_cl)
+    kw = {"vocab_size": 90} if model_name == "rnn" else {}
+    trainer = NWPTrainer(create_model(model_name, output_dim=VOCAB, **kw)
+                         if model_name.startswith("transformer")
+                         else create_model(model_name, output_dim=VOCAB, **kw))
+    agg = make_aggregator("fedopt", cfg)
+
+    seq = 16
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.zeros((2, seq), jnp.int32))
+    nprng = np.random.RandomState(0)
+    vocab = VOCAB if model_name.startswith("transformer") else 90
+    x = jnp.asarray(nprng.randint(1, vocab, (n_cl, 4, seq)), jnp.int32)
+    # transformer_nwp scores every position; "rnn" only the last one
+    y_shape = (n_cl, 4, seq) if model_name.startswith("transformer") \
+        else (n_cl, 4)
+    y = jnp.asarray(nprng.randint(1, vocab, y_shape), jnp.int32)
+    counts = jnp.full((n_cl,), 4, jnp.int32)
+
+    _, demoted = resolve_param_specs(rules_for_model(model_name), gv,
+                                     TENSOR_SHARDS)
+    row = {"model": model_name, "tensor_shards": TENSOR_SHARDS,
+           "aggregator": "fedopt(adam)", "demoted_leaves": demoted}
+    arms = {}
+    for arm, sh in (("replicated",
+                     TensorSharding(mesh, tuple(REPLICATED_RULES))),
+                    ("sharded",
+                     TensorSharding.for_model(mesh, model_name))):
+        round_fn = build_tensor_round_fn(trainer, cfg, agg, sh,
+                                         donate_state=True)
+        # fresh state per arm: device_put aliases device-resident buffers,
+        # so the donated round would delete a tree shared with the next arm
+        gv_arm = trainer.init(rng, jnp.zeros((2, seq), jnp.int32))
+        gvp, stp = sh.place(gv_arm), sh.place(agg.init_state(gv_arm))
+        arms[arm] = {
+            "params_bytes_per_dev": _device_bytes(gvp),
+            "state_bytes_per_dev": _device_bytes(gvp) + _device_bytes(stp),
+        }
+        # warm compile outside the timed window; state flows round-to-round
+        # exactly as the drive loop runs it (donated shards)
+        gvp, stp, _ = round_fn(gvp, stp, x, y, counts, rng)
+        jax.block_until_ready(gvp)
+        t0 = time.perf_counter()
+        for i in range(TIMED_STEPS):
+            gvp, stp, m = round_fn(gvp, stp, x, y, counts,
+                                   jax.random.PRNGKey(i + 1))
+        jax.block_until_ready(gvp)
+        arms[arm]["step_time_s"] = round(
+            (time.perf_counter() - t0) / TIMED_STEPS, 4)
+    row["arms"] = arms
+    row["params_shrink_x"] = round(
+        arms["replicated"]["params_bytes_per_dev"]
+        / arms["sharded"]["params_bytes_per_dev"], 3)
+    row["state_shrink_x"] = round(
+        arms["replicated"]["state_bytes_per_dev"]
+        / arms["sharded"]["state_bytes_per_dev"], 3)
+    return row
+
+
+def main():
+    import jax
+
+    rows = [bench_model("transformer_nwp"), bench_model("rnn")]
+    cores = os.cpu_count() or 1
+    platform = jax.devices()[0].platform
+    parsed = {
+        "metric": "tensor_shard_bytes",
+        "unit": "max per-device resident bytes (replicated vs sharded) + "
+                "mean round wall time over a forced 2x4 virtual mesh",
+        "mesh": f"{len(jax.devices()) // TENSOR_SHARDS}x{TENSOR_SHARDS}",
+        "models": rows,
+        "platform": platform,
+        "cpu_cores": cores,
+        # the 8-device mesh is virtual on CPU: timings are shape-only there
+        "cpu_capped": platform == "cpu" or cores < 8,
+    }
+    line = json.dumps(parsed)
+    print(line)
+    out = os.environ.get("BENCH_SHARD_OUT", "BENCH_SHARD_r01.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": len(rows),
+                       "cmd": "python tools/bench_tensor_shard.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
