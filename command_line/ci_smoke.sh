@@ -161,6 +161,64 @@ assert ev.get("checkpoint_save", 0) >= 1, ev
 print(f"OK trace: coverage={report['coverage']} events={ev}")
 EOF
 
+echo "== buffered straggler smoke (FedBuff drive: no round barrier, depth-2)"
+# seeded straggler plan: half the cohort arrives 1-2 dispatch rounds late,
+# updates land in the K=5 buffer staleness-discounted, outstanding arrivals
+# drain after the last dispatch round — every one of the 8*3 updates must
+# commit and some must carry staleness > 0
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 3 \
+  --epochs 1 --batch_size 4 --pipeline_depth 2 \
+  --buffer_size 5 --staleness_alpha 0.5 \
+  --chaos 1 --chaos_seed 7 --chaos_straggler_rate 0.5 --chaos_straggler_rounds 2
+assert_summary "committed_updates" 24 24
+assert_summary "staleness_sum" 1 1000
+assert_summary "Test/Acc" 0.0 1.0
+python - "$RUN_DIR" <<'EOF'
+import sys
+from fedml_tpu.telemetry.report import fold, load_trace
+report = fold(load_trace(f"{sys.argv[1]}/TRACE.jsonl"))
+ev = report["events"]
+assert ev.get("update_admitted", 0) == 24, ev
+assert ev.get("buffer_committed", 0) >= 4, ev  # 24 updates / K=5 -> >=4 fills
+print(f"OK buffered trace: events={ev}")
+EOF
+
+echo "== buffered determinism: same seed + stragglers => byte-identical params"
+python - <<'EOF'
+# the async schedule is a pure function of the seed: two buffered runs with
+# the same straggler plan must produce byte-for-byte the same final model
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+
+ds = load_dataset("mnist", client_num_in_total=8, partition_method="homo")
+
+def run():
+    cfg = FedConfig(comm_round=3, epochs=1, batch_size=4, lr=0.05,
+                    client_num_in_total=8, client_num_per_round=8,
+                    pipeline_depth=2, buffer_size=5, staleness_alpha=0.5)
+    api = FedAvgAPI(ds, cfg,
+                    ClassificationTrainer(create_model("lr", output_dim=10)))
+    api.train(chaos=FaultPlan(seed=7, straggler_rate=0.5, straggler_rounds=2))
+    return api
+
+a, b = run(), run()
+for x, y in zip(jax.tree.leaves(a.global_variables),
+                jax.tree.leaves(b.global_variables)):
+    assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), "params differ"
+assert a._buffer_host.committed_updates == b._buffer_host.committed_updates == 24
+print(f"OK buffered rerun byte-identical: {a._buffer_host.commits} commits, "
+      f"{a._buffer_host.committed_updates} updates")
+EOF
+
 echo "== perf-regression gate (ROADMAP item 5): TRACE rounds/s vs BENCH baseline"
 rm -f /tmp/ci_gate_trace.jsonl
 BENCH_PIPE_ROUNDS=10 BENCH_PIPE_REPS=2 BENCH_PIPE_DEPTHS=0 BENCH_PIPE_MODEL=lr \

@@ -40,6 +40,7 @@ from fedml_tpu.utils.pytree import (
     tree_add,
     tree_scale,
     tree_weighted_mean,
+    tree_where,
 )
 
 log = logging.getLogger(__name__)
@@ -360,6 +361,124 @@ class FedNovaAggregator:
         new_global = dict(wmean(rest, weights))
         new_global["params"] = new_params
         return new_global
+
+
+# --------------------------------------------------------------- buffered
+# Staleness-aware buffered aggregation (FedBuff): the admit/commit programs.
+# `algorithms/buffered.py` owns the drive loop and the host-side arrival
+# schedule; the in-graph rules live here next to the synchronous aggregators
+# they must stay bit-compatible with (the degenerate buffered config reduces
+# to the synchronous round — tests/test_buffered.py).
+
+
+def make_staleness_discount(alpha: float):
+    """The default pluggable staleness discount: an update born at round b
+    and committed at round t gets multiplier (1 + (t - b)) ** -alpha.
+
+    alpha = 0 (or staleness 0) yields EXACTLY 1.0 — IEEE pow(x, -0.0) == 1.0
+    and pow(1.0, y) == 1.0 — so the degenerate config multiplies weights by
+    the exact identity and stays bit-compatible with the synchronous round."""
+    alpha = float(alpha)
+
+    def discount(staleness):
+        return (1.0 + staleness) ** jnp.float32(-alpha)
+
+    return discount
+
+
+def build_buffer_admit(donate_buffer: bool = False):
+    """Jitted admit program: write one client row of a stacked LocalResult
+    into the K-row update buffer at index `fill`, tagged with its birth
+    round, and advance fill.
+
+    The buffer is a dict pytree {vars, steps, weights, metrics, birth, fill}
+    with a leading K axis on every row field (fill is a scalar i32).
+    `donate_buffer=True` donates the buffer into the program so XLA updates
+    the K-row copy in place — only safe when no guard snapshot holds the
+    old buffer's arrays (the drive loop gates it, mirroring the pipelined
+    loop's donate-when-restageable rule)."""
+
+    def admit(buf, stacked_vars, stacked_steps, stacked_metrics, counts,
+              src, birth_round):
+        def take(leaf):
+            return jax.lax.dynamic_index_in_dim(leaf, src, 0, keepdims=False)
+
+        def put(row_buf, row):
+            return jax.lax.dynamic_update_index_in_dim(
+                row_buf, row.astype(row_buf.dtype), buf["fill"], 0)
+
+        return {
+            "vars": jax.tree.map(put, buf["vars"],
+                                 jax.tree.map(take, stacked_vars)),
+            "steps": put(buf["steps"], take(stacked_steps)),
+            "weights": put(buf["weights"],
+                           take(counts).astype(jnp.float32)),
+            "metrics": {k: put(buf["metrics"][k], take(v))
+                        for k, v in stacked_metrics.items()},
+            "birth": put(buf["birth"], jnp.asarray(birth_round, jnp.int32)),
+            "fill": buf["fill"] + 1,
+        }
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="buffered.admit",
+                   donate=donate_buffer)
+    if not donate_buffer:
+        return jax.jit(admit)
+    jitted = jax.jit(admit, donate_argnums=(0,))
+
+    def donating_admit(*args):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*onat")
+            return jitted(*args)
+
+    donating_admit.jitted = jitted  # graft-lint donation introspection
+    return donating_admit
+
+
+def build_buffer_commit(aggregator, discount_fn):
+    """Jitted commit program: staleness-discount the buffered rows, run the
+    quarantine stage and the aggregator over them.
+
+    Rows at index >= fill (a partial final flush, or stale slots from an
+    earlier commit) are masked out through the SAME participation-mask path
+    the synchronous round uses, so a full buffer with zero staleness feeds
+    the aggregator bit-identical inputs to the synchronous masked round.
+    When every row quarantines, globals and aggregator state pass through
+    unchanged (no NaN escape), exactly like engine.build_round_fn_from_update.
+    The program only READS the buffer — the drive loop resets the host-mirrored
+    fill scalar itself, so no K-row copy flows back per commit."""
+    # LocalResult lives in engine; the import is lazy for the same
+    # engine<->aggregators cycle make_server_optimizer documents
+    from fedml_tpu.algorithms.engine import LocalResult
+
+    def commit(global_variables, agg_state, buf, commit_round, rng):
+        k = buf["weights"].shape[0]
+        staleness = (jnp.asarray(commit_round, jnp.int32)
+                     - buf["birth"]).astype(jnp.float32)
+        weights = buf["weights"] * discount_fn(staleness)
+        participation = jnp.arange(k, dtype=jnp.int32) < buf["fill"]
+        result = LocalResult(buf["vars"], buf["steps"], buf["metrics"])
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator(
+            global_variables, result, weights, rng, agg_state)
+        any_alive = jnp.any(alive)
+        new_global = tree_where(any_alive, new_global, global_variables)
+        new_state = tree_where(any_alive, new_state, agg_state)
+        metrics = {name: v.sum() for name, v in result.metrics.items()}
+        metrics["participated_count"] = alive.sum().astype(jnp.float32)
+        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        alive_f = alive.astype(jnp.float32)
+        metrics["staleness_sum"] = jnp.sum(staleness * alive_f)
+        metrics["staleness_max"] = jnp.max(
+            jnp.where(alive, staleness, jnp.zeros((), jnp.float32)))
+        return new_global, new_state, metrics
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="buffered.commit", donate=False)
+    return jax.jit(commit)
 
 
 AGGREGATORS = {

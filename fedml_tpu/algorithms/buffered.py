@@ -1,0 +1,365 @@
+"""Staleness-aware buffered asynchronous aggregation (FedBuff) — the drive
+loop that removes the global round barrier.
+
+Every synchronous drive loop commits on a round barrier: one straggler stalls
+the whole cohort (ROADMAP item 3). Here client updates are *admitted* into a
+device-resident K-row buffer the moment they arrive, tagged with their birth
+round, and *committed* into globals (and FedOpt momenta) only when K updates
+have accumulated — commits are decoupled from dispatch rounds, so a slow
+client delays nobody; its update lands late and staleness-discounted
+(`weight * (1 + staleness) ** -alpha` by default — pluggable via
+`aggregators.make_staleness_discount`) instead of being dropped.
+
+Determinism is the same bar PR 4/5 set, without an execution barrier: the
+arrival schedule is a pure function of the seed. At dispatch round t the
+whole cohort's updates are computed against the globals *as of dispatch*
+(one jitted `client_step` program — vmap(local_update), no aggregation);
+each client's arrival round is t + latency, with latency drawn from the
+seeded straggler plan (`robustness.chaos.FaultPlan.latencies`). Arrivals are
+processed in deterministic (arrival_round, birth_round, slot) order, so the
+sequence of admit/commit programs — and therefore the final model — is
+bitwise reproducible run-to-run. The degenerate config (buffer_size =
+cohort, alpha = 0, no stragglers) admits each round's cohort in slot order
+and commits exactly once per round with zero staleness, reproducing the
+synchronous round's aggregation bit-exactly (tests/test_buffered.py).
+
+Guard integration: the pre-round snapshot covers globals, aggregator state,
+the update buffer, its birth tags, AND the host-side pending-arrival
+schedule, so a rollback rewinds the whole async timeline; the retried round
+re-runs with a salted rng, exactly like the synchronous loops. The buffer is
+donated into the admit program only when no guard is armed — a guard
+snapshot holds the buffer's arrays, and donation would deallocate them (the
+same donate-when-restageable rule the pipelined loop applies to cohorts).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu import telemetry
+from fedml_tpu.algorithms.aggregators import (
+    build_buffer_admit,
+    build_buffer_commit,
+    make_staleness_discount,
+)
+from fedml_tpu.algorithms.engine import _vmapped_update
+from fedml_tpu.data.prefetch import CohortPrefetcher
+from fedml_tpu.robustness.chaos import summarize as chaos_summary
+from fedml_tpu.telemetry.records import RoundRecordLog
+
+log = logging.getLogger(__name__)
+
+
+def build_client_step_fn(trainer, cfg, donate_data: bool = False):
+    """Jitted cohort step WITHOUT aggregation: vmap(local_update) over the
+    staged cohort, same per-client rng stream as the synchronous round
+    (crngs = split(round_rng, C)) — so a buffered run and a synchronous run
+    at the same round rng train bit-identical client updates. The stacked
+    LocalResult stays device-resident until every row has been admitted."""
+    batched = _vmapped_update(trainer, cfg)
+
+    def client_step(global_variables, x, y, counts, rng):
+        crngs = jax.random.split(rng, x.shape[0])
+        return batched(global_variables, x, y, counts, crngs)
+
+    telemetry.emit("round_fn_built", program="buffered.client_step",
+                   donate=donate_data)
+    if not donate_data:
+        return jax.jit(client_step)
+    # x/y are staged fresh per round (and re-staged on a guard retry), so
+    # their HBM may be reused in place; counts survives — the admit program
+    # reads it long after the step
+    jitted = jax.jit(client_step, donate_argnums=(1, 2))
+
+    def donating_client_step(*args):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*onat")
+            return jitted(*args)
+
+    donating_client_step.jitted = jitted  # graft-lint donation introspection
+    return donating_client_step
+
+
+def init_buffer(result, k: int) -> Dict[str, Any]:
+    """Fresh all-zero K-row update buffer shaped after one stacked
+    LocalResult (row shapes = the per-client shapes)."""
+    def row(l):
+        return jnp.zeros((k,) + l.shape[1:], l.dtype)
+
+    return {
+        "vars": jax.tree.map(row, result.variables),
+        "steps": jnp.zeros((k,), result.num_steps.dtype),
+        "weights": jnp.zeros((k,), jnp.float32),
+        "metrics": {name: row(v) for name, v in result.metrics.items()},
+        "birth": jnp.zeros((k,), jnp.int32),
+        "fill": jnp.zeros((), jnp.int32),
+    }
+
+
+class _HostState:
+    """The host-side mirror of the async schedule — everything the guard
+    snapshot must capture beyond the device pytrees."""
+
+    def __init__(self):
+        # birth -> {"vars","steps","metrics","counts","remaining"}: stacked
+        # client-step results held until every arriving row is admitted
+        self.pending: Dict[int, Dict[str, Any]] = {}
+        # arrival_round -> [(birth, slot), ...]
+        self.arrivals: Dict[int, List[Tuple[int, int]]] = {}
+        self.fill = 0            # mirrors buf["fill"] (admits are host-driven)
+        self.births: List[int] = []  # birth tag of each filled buffer row
+        self.commits = 0
+        self.committed_updates = 0
+
+    def snapshot(self):
+        return (
+            {b: dict(d) for b, d in self.pending.items()},
+            {r: list(v) for r, v in self.arrivals.items()},
+            self.fill, list(self.births), self.commits,
+            self.committed_updates,
+        )
+
+    def restore(self, snap):
+        pending, arrivals, fill, births, commits, committed = snap
+        self.pending = {b: dict(d) for b, d in pending.items()}
+        self.arrivals = {r: list(v) for r, v in arrivals.items()}
+        self.fill = fill
+        self.births = list(births)
+        self.commits = commits
+        self.committed_updates = committed
+
+
+def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
+                   metrics_logger, chaos, guard, tracer,
+                   discount_fn=None) -> None:
+    """The buffered drive loop (`cfg.buffer_size > 0`), called from
+    FedAvgAPI.train() under its tracer/checkpoint scaffolding.
+
+    Per dispatch round t: stage the cohort (through the SAME `stage_fn` seam
+    as the synchronous loops — with `cfg.pipeline_depth > 0` a background
+    prefetcher stages rounds t+1..t+depth while t executes), run the
+    client-step program against the current globals, schedule each
+    surviving client's arrival at t + latency (seeded straggler plan; 0
+    without chaos), then admit every update whose arrival round is t and
+    commit whenever the buffer reaches K. After the last dispatch round the
+    outstanding arrivals drain on virtual rounds, and a final partial
+    buffer flushes through the participation-masked commit path."""
+    cfg = api.cfg
+    k = int(cfg.buffer_size)
+    if k < 1:
+        raise ValueError(f"buffer_size must be >= 1 in buffered mode, got {k}")
+    if discount_fn is None:
+        discount_fn = make_staleness_discount(cfg.staleness_alpha)
+    donate_buffer = guard is None
+    admit_fn = build_buffer_admit(donate_buffer=donate_buffer)
+    commit_fn = build_buffer_commit(api.aggregator, discount_fn)
+    client_step = build_client_step_fn(api.trainer, cfg, donate_data=True)
+    records = RoundRecordLog(tracer, api.history, metrics_logger)
+    prefetcher = None
+    if cfg.pipeline_depth > 0:
+        prefetcher = CohortPrefetcher(
+            lambda r: api.stage_fn(r, chaos=chaos), depth=cfg.pipeline_depth)
+        api._last_prefetcher = prefetcher  # test/ops introspection
+
+    host = _HostState()
+    api._buffer = None  # device buffer; exposed for tests/introspection
+    api._buffer_host = host
+
+    def base_rng(round_idx: int, salt: int):
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
+        if salt:
+            rng = jax.random.fold_in(rng, salt)
+        return rng
+
+    def do_commit(commit_round: int, rng_round, seq: int, commit_metrics):
+        """One buffer commit; returns the commit's device metric dict."""
+        rng = rng_round if seq == 0 else jax.random.fold_in(rng_round, seq)
+        with tracer.span("commit", commit_round):
+            api.global_variables, api.agg_state, m = commit_fn(
+                api.global_variables, api.agg_state, api._buffer,
+                np.int32(commit_round), rng)
+        staleness = [commit_round - b for b in host.births]
+        p50 = float(np.median(staleness)) if staleness else 0.0
+        smax = max(staleness) if staleness else 0
+        tracer.event("buffer_committed", round=commit_round, size=host.fill,
+                     staleness_p50=p50, staleness_max=int(smax))
+        telemetry.gauge("staleness", round=commit_round, p50=p50,
+                        max=int(smax))
+        host.committed_updates += host.fill
+        host.commits += 1
+        host.fill = 0
+        host.births = []
+        # the commit only read the buffer — reset the fill scalar host-side
+        api._buffer = dict(api._buffer, fill=jnp.zeros((), jnp.int32))
+        commit_metrics.append(m)
+
+    def process_arrivals(now: int, rng_round, commit_metrics,
+                         seq_base: int) -> int:
+        """Admit round `now`'s due arrivals in (birth, slot) order; commit
+        every time the buffer fills. Returns the number of commits made."""
+        due = sorted(host.arrivals.pop(now, []))
+        n_commits = 0
+        for birth, slot in due:
+            src = host.pending[birth]
+            with tracer.span("admit", now):
+                api._buffer = admit_fn(
+                    api._buffer, src["vars"], src["steps"], src["metrics"],
+                    src["counts"], np.int32(slot), np.int32(birth))
+            host.fill += 1
+            host.births.append(birth)
+            tracer.event("update_admitted", round=now, birth=birth,
+                         fill=host.fill)
+            src["remaining"] -= 1
+            if src["remaining"] == 0:
+                del host.pending[birth]
+            if host.fill == k:
+                do_commit(now, rng_round, seq_base + n_commits,
+                          commit_metrics)
+                n_commits += 1
+        return n_commits
+
+    round_idx = start_round
+    retries = 0
+    try:
+        while round_idx < cfg.comm_round:
+            with tracer.round(round_idx) as rspan:
+                with tracer.span("stage_wait", round_idx):
+                    staged = (prefetcher.get(round_idx) if prefetcher
+                              else api.stage_fn(round_idx, chaos=chaos,
+                                                tracer=tracer))
+                assert staged.round_idx == round_idx
+                if prefetcher:
+                    for ahead in range(1, cfg.pipeline_depth + 1):
+                        if round_idx + ahead < cfg.comm_round:
+                            prefetcher.prefetch(round_idx + ahead)
+                snapshot = None
+                if guard is not None:
+                    # jax pytrees are immutable: holding refs IS the device
+                    # snapshot; the host schedule needs explicit copies
+                    snapshot = (api._ckpt_tree(), api._ckpt_meta(),
+                                api._buffer, host.snapshot())
+                rng_round = base_rng(round_idx, retries)
+                with tracer.span("dispatch", round_idx):
+                    result = client_step(api.global_variables, staged.x,
+                                         staged.y, staged.counts, rng_round)
+                if api._buffer is None:
+                    api._buffer = init_buffer(result, k)
+                n = len(staged.client_idx)
+                lat = (chaos.latencies(round_idx, n) if chaos is not None
+                       else np.zeros(n, np.int32)).tolist()
+                surviving = [c for c in range(n)
+                             if staged.faults is None
+                             or bool(staged.faults.participation[c])]
+                for c in surviving:
+                    host.arrivals.setdefault(
+                        round_idx + lat[c], []).append((round_idx, c))
+                if surviving:
+                    host.pending[round_idx] = {
+                        "vars": result.variables,
+                        "steps": result.num_steps,
+                        "metrics": result.metrics,
+                        "counts": staged.counts,
+                        "remaining": len(surviving),
+                    }
+                commit_metrics: list = []
+                n_commits = process_arrivals(round_idx, rng_round,
+                                             commit_metrics, seq_base=0)
+                telemetry.gauge("buffer_fill", round=round_idx,
+                                fill=host.fill, commits=n_commits)
+                train_metrics: dict = {}
+                if commit_metrics:
+                    with tracer.span("metrics_fetch", round_idx):
+                        for m in jax.device_get(commit_metrics):
+                            for key in m:
+                                train_metrics[key] = (
+                                    train_metrics.get(key, 0.0)
+                                    + float(m[key]))
+                if guard is not None and commit_metrics:
+                    total = max(train_metrics.get("total", 1.0), 1.0)
+                    loss = train_metrics.get("loss_sum", 0.0) / total
+                    with tracer.span("guard_verdict", round_idx):
+                        verdict = guard.inspect(round_idx, loss,
+                                                api.global_variables)
+                    tracer.event("guard_verdict", round=round_idx,
+                                 ok=verdict.ok, reason=verdict.reason)
+                    if not verdict.ok and retries < guard.max_retries:
+                        retries += 1
+                        log.warning(
+                            "guard: %s — rolled back (buffer + schedule), "
+                            "retrying with fresh rng (%d/%d)",
+                            verdict.reason, retries, guard.max_retries)
+                        tracer.event("guard_rollback", round=round_idx,
+                                     retry=retries)
+                        api._ckpt_load(snapshot[0], snapshot[1])
+                        api._buffer = snapshot[2]
+                        host.restore(snapshot[3])
+                        if prefetcher:
+                            prefetcher.invalidate()
+                        continue
+                    if not verdict.ok:
+                        log.warning("guard: %s — retries exhausted, "
+                                    "accepting the round", verdict.reason)
+                        tracer.event("guard_exhausted", round=round_idx)
+                record = {"round": round_idx, "round_time": rspan.elapsed(),
+                          "buffer_commits": n_commits,
+                          "committed_updates": host.committed_updates,
+                          "buffer_fill": host.fill}
+                for key in ("loss_sum", "total", "participated_count",
+                            "quarantined_count", "staleness_sum",
+                            "staleness_max"):
+                    if key in train_metrics:
+                        record[key] = train_metrics[key]
+                if staged.faults is not None:
+                    record.update(chaos_summary(staged.faults))
+                if guard is not None and retries:
+                    record["guard_retries"] = retries
+                retries = 0
+                if (round_idx % cfg.frequency_of_the_test == 0
+                        or round_idx == cfg.comm_round - 1):
+                    with tracer.span("eval", round_idx):
+                        record.update(
+                            api.local_test_on_all_clients(round_idx))
+                        record.update(api.test_global(round_idx))
+                records.add(record)
+                records.flush(round_idx)
+                if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
+                    with tracer.span("checkpoint", round_idx):
+                        api.save_checkpoint(ckpt_dir, round_idx + 1)
+            round_idx += 1
+    finally:
+        if prefetcher:
+            prefetcher.close()
+
+    # -- drain: outstanding straggler arrivals land on virtual rounds past
+    # the last dispatch, then the final partial buffer flushes through the
+    # masked commit path (participation = arange(K) < fill). No new client
+    # work runs here, so the schedule stays a pure function of the seed.
+    drain_round = cfg.comm_round
+    commit_metrics = []
+    drain_commits = 0
+    while host.arrivals:
+        rng_round = base_rng(drain_round, 0)
+        drain_commits += process_arrivals(drain_round, rng_round,
+                                          commit_metrics, seq_base=0)
+        drain_round += 1
+    if host.fill > 0:
+        do_commit(drain_round, base_rng(drain_round, 0), 0, commit_metrics)
+        drain_commits += 1
+    if drain_commits:
+        record = {"round": cfg.comm_round, "round_time": 0.0,
+                  "buffer_commits": drain_commits,
+                  "committed_updates": host.committed_updates,
+                  "buffer_fill": host.fill}
+        with tracer.span("metrics_fetch", drain_round):
+            for m in jax.device_get(commit_metrics):
+                for key in m:
+                    record[key] = record.get(key, 0.0) + float(m[key])
+        records.add(record)
+        records.flush(cfg.comm_round)

@@ -116,6 +116,16 @@ class FedAvgAPI(Checkpointable):
         self.aggregator = make_aggregator(aggregator_name, config)
         self.mesh = None
         self._tensor_sharding = None
+        if config.buffer_size > 0 and (
+                config.backend != "vmap" or config.tensor_shards > 0
+                or config.silo_threshold > 0):
+            raise ValueError(
+                "buffer_size (staleness-aware buffered aggregation) drives "
+                "the single-controller vmap engine; the sharded admit/commit "
+                "twin (parallel.sharded.build_sharded_buffer_fns) is a "
+                "program-level building block — combine buffer_size with "
+                "neither backend='shard_map', tensor_shards, nor "
+                "silo_threshold")
         if config.silo_threshold > 0 and config.backend == "shard_map":
             raise ValueError(
                 "silo_threshold (the single-chip silo-grouped conv path) "
@@ -262,7 +272,15 @@ class FedAvgAPI(Checkpointable):
         telemetry.install(tracer)
         try:
             with tracer.span("drive"):
-                if cfg.pipeline_depth > 0:
+                if cfg.buffer_size > 0:
+                    # staleness-aware buffered aggregation (FedBuff): no
+                    # global round barrier — commits fire when K updates
+                    # have accumulated, stragglers admitted late
+                    from fedml_tpu.algorithms.buffered import train_buffered
+
+                    train_buffered(self, start_round, ckpt_dir, ckpt_every,
+                                   metrics_logger, chaos, guard, tracer)
+                elif cfg.pipeline_depth > 0:
                     self._train_pipelined(start_round, ckpt_dir, ckpt_every,
                                           metrics_logger, chaos, guard, tracer)
                 else:

@@ -207,6 +207,52 @@ def _tensor_round(model_name: str, agg_name: str):
     return round_fn, args, _tree_bytes(gv)
 
 
+def _buffered_program(which: str, agg_name: str):
+    """The buffered-aggregation admit/commit shard_map programs
+    (parallel/sharded.py build_sharded_buffer_fns) on the 8-device clients
+    mesh: buffer rows AND the stacked client-step result sharded over
+    'clients'. Admit's budget pins the one param-sized masked psum that
+    moves the source row to the buffer's owner; commit's pins the
+    aggregator's psum-reduction traffic (the synchronous round's
+    aggregation half, no client-step collectives)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.algorithms.aggregators import (make_aggregator,
+                                                  make_staleness_discount)
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.parallel.sharded import build_sharded_buffer_fns
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("clients",))
+    trainer = _lr_trainer()
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    agg = make_aggregator(agg_name, cfg)
+    admit_fn, commit_fn = build_sharded_buffer_fns(
+        agg, make_staleness_discount(0.5), mesh)
+    gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+    c = k = N_DEV  # one stacked-result row and one buffer row per device
+    i32 = lambda shape=(): jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+    row = lambda l: jax.ShapeDtypeStruct((k,) + l.shape, l.dtype)  # noqa: E731
+    buf = {
+        "vars": jax.tree.map(row, gv),
+        "steps": i32((k,)),
+        "weights": jax.ShapeDtypeStruct((k,), jnp.float32),
+        "metrics": {"loss_sum": jax.ShapeDtypeStruct((k,), jnp.float32),
+                    "total": jax.ShapeDtypeStruct((k,), jnp.float32)},
+        "birth": i32((k,)),
+    }
+    if which == "admit":
+        stacked = jax.tree.map(lambda l: jax.ShapeDtypeStruct(
+            (c,) + l.shape[1:], l.dtype), buf)
+        args = (buf, i32(), stacked["vars"], stacked["steps"],
+                stacked["metrics"], i32((c,)), i32(), i32())
+        return admit_fn, args, _tree_bytes(gv)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    args = (gv, agg_state, buf, i32(), i32(), rng)
+    return commit_fn, args, _tree_bytes(gv)
+
+
 def _engine_round():
     import jax
     import jax.numpy as jnp
@@ -270,6 +316,12 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
         lambda: _tensor_round("lr", "robust"), N_DEV),
     "tensor.round[lr,f32,fednova,2x4]": (
         lambda: _tensor_round("lr", "fednova"), N_DEV),
+    "buffered.admit[lr,f32]": (
+        lambda: _buffered_program("admit", "fedavg"), N_DEV),
+    "buffered.commit[lr,f32,fedavg]": (
+        lambda: _buffered_program("commit", "fedavg"), N_DEV),
+    "buffered.commit[lr,f32,fedopt]": (
+        lambda: _buffered_program("commit", "fedopt"), N_DEV),
     "gossip.mix[ring8]": (_gossip_mix, N_DEV),
     "sequence.ring[b1,t64,h8,d16]": (_ring_attention, N_DEV),
     "sequence.ulysses[b1,t64,h8,d16]": (_ulysses_attention, N_DEV),

@@ -107,6 +107,18 @@ class FedConfig:
     # client ids). Default off: the default path keeps bit-compat with the
     # seeded rng.choice trajectory of fedavg.client_sampling.
     fast_sampling: bool = False
+    # >0 enables staleness-aware buffered aggregation (FedBuff): client
+    # updates are admitted into a device-resident K-row buffer tagged with
+    # their birth round and committed into globals only when K updates have
+    # accumulated — no global round barrier. Arrival order comes from the
+    # chaos straggler plan; the degenerate config (buffer_size = cohort,
+    # staleness_alpha = 0, no stragglers) is bit-identical to the
+    # synchronous loop (tests/test_buffered.py). 0 = synchronous legacy.
+    buffer_size: int = 0
+    # Staleness-discount exponent: an update born at round b and committed
+    # at round t gets weight count * (1 + (t - b)) ** -alpha. 0 disables
+    # discounting ((1+s)**-0 == 1.0 exactly, preserving bit-identity).
+    staleness_alpha: float = 0.5
     dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models
 
     extra: dict[str, Any] = field(default_factory=dict, hash=False, compare=False)

@@ -137,11 +137,22 @@ def _download(url: str, dst: str, fetcher=None, policy: RetryPolicy | None = Non
         kwargs["sleep"] = sleep
     if rng is not None:
         kwargs["rng"] = rng
+
+    def on_retry(attempt, exc, delay):
+        from fedml_tpu import telemetry
+
+        # status: the HTTP code when the server answered, else the failure
+        # class name (ConnectionResetError, TimeoutError, ...)
+        status = (str(exc.code) if isinstance(exc, urllib.error.HTTPError)
+                  else type(exc).__name__)
+        telemetry.emit("download_retry", attempt=attempt, status=status,
+                       backoff_s=delay)
+        print(f"  download failed ({exc}); retry {attempt} in {delay:.1f}s")
+
     call_with_retry(
         once,
         policy=policy or DOWNLOAD_POLICY,
-        on_retry=lambda attempt, exc, delay: print(
-            f"  download failed ({exc}); retry {attempt} in {delay:.1f}s"),
+        on_retry=on_retry,
         **kwargs,
     )
 

@@ -59,6 +59,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--chaos_drop_rate", type=float, default=0.0)
     parser.add_argument("--chaos_nan_rate", type=float, default=0.0)
     parser.add_argument("--chaos_corrupt_rate", type=float, default=0.0)
+    # seeded straggler plan (buffered aggregation): straggling clients'
+    # updates arrive 1..straggler_rounds dispatch rounds late
+    parser.add_argument("--chaos_straggler_rate", type=float, default=0.0)
+    parser.add_argument("--chaos_straggler_rounds", type=int, default=0)
     parser.add_argument("--guard", type=int, default=0,
                         help="1 = roll back + re-run rounds whose loss goes "
                              "non-finite or spikes")
@@ -82,6 +86,15 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="1 = O(cohort) Feistel-permutation cohort "
                              "sampler (different seeded trajectory than the "
                              "default O(N) sampler)")
+    # staleness-aware buffered aggregation (fedml_tpu.algorithms.buffered):
+    # admit updates into a K-row device buffer, commit when it fills — no
+    # global round barrier; deterministic under the seeded straggler plan
+    parser.add_argument("--buffer_size", type=int, default=0,
+                        help="update-buffer size K for FedBuff-style "
+                             "buffered aggregation (0 = synchronous)")
+    parser.add_argument("--staleness_alpha", type=float, default=0.5,
+                        help="staleness-discount exponent: committed weight "
+                             "= count * (1 + staleness) ** -alpha")
     # graft-trace observability (fedml_tpu.telemetry): TRACE.jsonl is
     # always written to <run_dir>/TRACE.jsonl; these knobs add sinks
     parser.add_argument("--trace_summary", type=int, default=0,
@@ -105,10 +118,13 @@ def robustness_from_args(args):
     if getattr(args, "chaos", 0):
         from fedml_tpu.robustness.chaos import FaultPlan
 
-        chaos = FaultPlan(seed=args.chaos_seed,
-                          drop_rate=args.chaos_drop_rate,
-                          nan_rate=args.chaos_nan_rate,
-                          corrupt_rate=args.chaos_corrupt_rate)
+        chaos = FaultPlan(
+            seed=args.chaos_seed,
+            drop_rate=args.chaos_drop_rate,
+            nan_rate=args.chaos_nan_rate,
+            corrupt_rate=args.chaos_corrupt_rate,
+            straggler_rate=getattr(args, "chaos_straggler_rate", 0.0),
+            straggler_rounds=getattr(args, "chaos_straggler_rounds", 0))
     if getattr(args, "guard", 0):
         from fedml_tpu.robustness.guard import RoundGuard
 

@@ -11,7 +11,10 @@ from fedml_tpu.parallel.hierarchical import (  # noqa: F401
     build_sharded_hierarchical_round_fn,
 )
 from fedml_tpu.parallel.mesh import make_mesh, make_tensor_mesh  # noqa: F401
-from fedml_tpu.parallel.sharded import build_sharded_round_fn  # noqa: F401
+from fedml_tpu.parallel.sharded import (  # noqa: F401
+    build_sharded_buffer_fns,
+    build_sharded_round_fn,
+)
 from fedml_tpu.parallel.tensor import (  # noqa: F401
     RULE_TABLES,
     TensorSharding,

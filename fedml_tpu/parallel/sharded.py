@@ -117,3 +117,135 @@ def build_sharded_round_fn(
                        participation)
 
     return jax.jit(round_fn)
+
+
+def build_sharded_buffer_fns(
+    aggregator,
+    discount_fn,
+    mesh: Mesh,
+    axis: str = "clients",
+) -> tuple:
+    """The buffered-aggregation admit/commit programs with the K-row update
+    buffer (and the stacked client-step result) sharded over mesh `axis` —
+    the shard_map twin of aggregators.build_buffer_admit/build_buffer_commit.
+
+    `admit(buf, fill, stacked_vars, stacked_steps, stacked_metrics, counts,
+    src, birth_round)` moves ONE client row (global index `src` in the
+    client-sharded stacked result) into buffer row `fill`: the owning device
+    contributes the row to a masked param-sized psum (the twin's only
+    admit-time collective — C-invariant, vs. an all_gather's C-fold bytes)
+    and the device owning buffer row `fill` where-writes it. `fill` travels
+    as a separate replicated scalar — the host mirrors it exactly as in the
+    vmap drive loop — so the buffer dict's in_specs stay uniformly P(axis).
+
+    `commit(gv, agg_state, buf, fill, commit_round, rng)` mirrors the vmap
+    commit: staleness discount and quarantine run shard-local, then the
+    aggregator's `sharded` rule reduces with param-sized psums. Equal to the
+    vmap commit up to float summation order, same bar as
+    build_sharded_round_fn (tests/test_buffered.py)."""
+    from fedml_tpu.algorithms.engine import LocalResult
+
+    n_dev = mesh.shape[axis]
+
+    def admit_body(buf, fill, stacked_vars, stacked_steps, stacked_metrics,
+                   counts, src, birth_round):
+        c_local = stacked_steps.shape[0]
+        k_local = buf["steps"].shape[0]
+        didx = jax.lax.axis_index(axis)
+
+        # fetch: the owner's row, everywhere (one param-sized masked psum)
+        src_local = jnp.clip(src - didx * c_local, 0, c_local - 1)
+        has_src = (src >= didx * c_local) & (src < (didx + 1) * c_local)
+
+        def fetch(stacked):
+            row = jax.lax.dynamic_index_in_dim(stacked, src_local, 0,
+                                               keepdims=False)
+            return jax.lax.psum(
+                jnp.where(has_src, row, jnp.zeros((), row.dtype)), axis)
+
+        row_vars = jax.tree.map(fetch, stacked_vars)
+        row_steps = fetch(stacked_steps)
+        row_weight = fetch(counts).astype(jnp.float32)
+        row_metrics = {k: fetch(v) for k, v in stacked_metrics.items()}
+
+        # write: only the device owning global buffer row `fill` lands it
+        dst_local = jnp.clip(fill - didx * k_local, 0, k_local - 1)
+        has_dst = (fill >= didx * k_local) & (fill < (didx + 1) * k_local)
+
+        def put(row_buf, row):
+            updated = jax.lax.dynamic_update_index_in_dim(
+                row_buf, row.astype(row_buf.dtype), dst_local, 0)
+            return jnp.where(has_dst, updated, row_buf)
+
+        return {
+            "vars": jax.tree.map(put, buf["vars"], row_vars),
+            "steps": put(buf["steps"], row_steps),
+            "weights": put(buf["weights"], row_weight),
+            "metrics": {k: put(buf["metrics"][k], v)
+                        for k, v in row_metrics.items()},
+            "birth": put(buf["birth"],
+                         jnp.asarray(birth_round, jnp.int32)),
+        }
+
+    def commit_body(global_variables, agg_state, buf, fill, commit_round,
+                    rng):
+        k_local = buf["steps"].shape[0]
+        didx = jax.lax.axis_index(axis)
+        global_idx = didx * k_local + jnp.arange(k_local, dtype=jnp.int32)
+        staleness = (jnp.asarray(commit_round, jnp.int32)
+                     - buf["birth"]).astype(jnp.float32)
+        weights = buf["weights"] * discount_fn(staleness)
+        participation = global_idx < fill
+        result = LocalResult(buf["vars"], buf["steps"], buf["metrics"])
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator.sharded(
+            global_variables, result, weights, rng, agg_state, axis)
+        metrics = {k: jax.lax.psum(v.sum(), axis)
+                   for k, v in result.metrics.items()}
+        alive_total = jax.lax.psum(alive.sum(), axis)
+        any_alive = alive_total > 0
+        new_global = tree_where(any_alive, new_global, global_variables)
+        new_state = tree_where(any_alive, new_state, agg_state)
+        metrics["participated_count"] = alive_total.astype(jnp.float32)
+        metrics["quarantined_count"] = jax.lax.psum(
+            quarantined.sum(), axis).astype(jnp.float32)
+        alive_f = alive.astype(jnp.float32)
+        metrics["staleness_sum"] = jax.lax.psum(
+            jnp.sum(staleness * alive_f), axis)
+        metrics["staleness_max"] = jax.lax.pmax(
+            jnp.max(jnp.where(alive, staleness,
+                              jnp.zeros((), jnp.float32))), axis)
+        return new_global, new_state, metrics
+
+    buf_spec = {"vars": P(axis), "steps": P(axis), "weights": P(axis),
+                "metrics": P(axis), "birth": P(axis)}
+
+    def admit_fn(buf, fill, stacked_vars, stacked_steps, stacked_metrics,
+                 counts, src, birth_round):
+        sharded = shard_map(
+            admit_body,
+            mesh=mesh,
+            in_specs=(buf_spec, P(), P(axis), P(axis), P(axis), P(axis),
+                      P(), P()),
+            out_specs=buf_spec,
+        )
+        return sharded(buf, fill, stacked_vars, stacked_steps,
+                       stacked_metrics, counts, src, birth_round)
+
+    def commit_fn(global_variables, agg_state, buf, fill, commit_round, rng):
+        sharded = shard_map(
+            commit_body,
+            mesh=mesh,
+            in_specs=(P(), P(), buf_spec, P(), P(), P()),
+            out_specs=(P(), P(), P()),
+        )
+        return sharded(global_variables, agg_state, buf, fill, commit_round,
+                       rng)
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="buffered.admit.sharded",
+                   donate=False)
+    telemetry.emit("round_fn_built", program="buffered.commit.sharded",
+                   donate=False)
+    return jax.jit(admit_fn), jax.jit(commit_fn)

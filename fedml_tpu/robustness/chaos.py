@@ -24,6 +24,11 @@ import numpy as np
 
 from fedml_tpu import telemetry
 
+# Distinct third seed word for the straggler-latency rng stream: keeps
+# `latencies` draws independent of the `events` stream at the same
+# (seed, round_idx) without disturbing its byte-stable draw order.
+_STRAGGLER_STREAM = 0x5BA6
+
 
 class FaultEvents(NamedTuple):
     """Host-side fault decisions for one round (numpy, length n_clients)."""
@@ -50,6 +55,14 @@ class FaultPlan:
     drop_rate: float = 0.0
     nan_rate: float = 0.0
     corrupt_rate: float = 0.0
+    # straggler plan (buffered aggregation): each straggling client's update
+    # arrives `latency` dispatch rounds late (1..straggler_rounds, uniform)
+    # instead of at its birth round. Drawn from a SEPARATE rng stream
+    # (seed, round_idx, _STRAGGLER_STREAM) so enabling stragglers never
+    # perturbs the drop/nan/corrupt draws above — seeded chaos trajectories
+    # from earlier PRs stay bit-identical.
+    straggler_rate: float = 0.0
+    straggler_rounds: int = 0
     overrides: Dict[int, Dict[str, float]] = field(default_factory=dict)
 
     def rates_for(self, round_idx: int) -> Dict[str, float]:
@@ -75,6 +88,23 @@ class FaultPlan:
                        dropped=int(drop.sum()), nan=int(nan.sum()),
                        corrupt=int(corrupt.sum()))
         return events
+
+    def latencies(self, round_idx: int, n_clients: int) -> np.ndarray:
+        """Per-client arrival latency (int32 dispatch rounds, 0 = on time)
+        for the cohort dispatched at `round_idx` — the seeded straggler
+        plan. Pure in (plan, round_idx, n_clients), like `events`, so a
+        resumed or guard-retried run replays the identical arrival
+        schedule."""
+        lat = np.zeros(n_clients, np.int32)
+        if self.straggler_rate <= 0.0 or self.straggler_rounds <= 0:
+            return lat
+        rng = np.random.default_rng([self.seed, round_idx,
+                                     _STRAGGLER_STREAM])
+        straggle = rng.random(n_clients) < self.straggler_rate
+        draws = rng.integers(1, self.straggler_rounds + 1, n_clients,
+                             dtype=np.int32)
+        lat[straggle] = draws[straggle]
+        return lat
 
 
 def apply_faults(events: FaultEvents, x: np.ndarray) -> np.ndarray:

@@ -28,9 +28,10 @@ class RetryPolicy:
     pre-jitter delay after attempt 0; each subsequent failure multiplies it
     by `multiplier`, capped at `max_delay`. With jitter on, the actual sleep
     is uniform in [0, capped_delay]. `deadline` (seconds, measured on the
-    injected clock from the first attempt) bounds the whole loop: once
-    exceeded — or once the next sleep would overshoot it — the loop stops
-    retrying and raises RetryError.
+    injected clock from the first attempt) bounds the whole loop: each sleep
+    is clamped to the remaining budget (the loop never sleeps past the
+    deadline), and once no budget remains the loop stops retrying and raises
+    RetryError.
     """
 
     max_attempts: int = 5
@@ -98,9 +99,14 @@ def call_with_retry(
             final = attempt == policy.max_attempts - 1
             delay = 0.0 if final else policy.delay_for(attempt, rng)
             if not final and policy.deadline is not None:
-                elapsed = clock() - start
-                if elapsed + delay > policy.deadline:
+                remaining = policy.deadline - (clock() - start)
+                if remaining <= 0.0:
                     final = True
+                else:
+                    # clamp, don't give up: a jittered draw that would
+                    # overshoot sleeps exactly the remaining budget, so the
+                    # deadline buys every attempt it can afford
+                    delay = min(delay, remaining)
             if final:
                 raise RetryError(
                     f"{fn!r} failed after {attempt + 1} attempt(s): {e}",

@@ -133,8 +133,9 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 # picked up by the perf gate, whatever keys their schemas grow:
 # BENCH_SCALE_* record an RSS-vs-N curve at deliberately tiny round counts,
 # BENCH_SHARD_* record per-device param bytes on a forced 8-virtual-device
-# mesh. Both would poison the rounds/s comparison.
-_GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_")
+# mesh, BENCH_BUFF_* record committed-updates/s under a synthetic straggler
+# barrier. All would poison the rounds/s comparison.
+_GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_", "BENCH_BUFF_")
 
 
 def newest_bench(root: str) -> Optional[Tuple[str, Dict[str, Any]]]:

@@ -67,6 +67,13 @@ EVENT_SCHEMAS: Dict[str, set] = {
     "compile_cache": {"name"},
     # round-program construction (algorithms/engine.py)
     "round_fn_built": {"program", "donate"},
+    # buffered aggregation (algorithms/buffered.py): one per admitted client
+    # update (`fill` = buffer occupancy after the admit) and one per buffer
+    # commit (`size` = rows committed, staleness in dispatch rounds)
+    "update_admitted": {"round", "birth", "fill"},
+    "buffer_committed": {"round", "size", "staleness_p50", "staleness_max"},
+    # data plane download retries (data/acquire.py), mirroring mqtt_reconnect
+    "download_retry": {"attempt", "status", "backoff_s"},
 }
 
 

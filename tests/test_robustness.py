@@ -341,6 +341,48 @@ def test_chaos_training_is_deterministic_end_to_end(ds8):
     assert sum(r["chaos_dropped"] for r in h1) > 0
 
 
+def test_chaos_with_fast_sampling_is_deterministic(ds16):
+    """Satellite (ISSUE 9): the O(cohort) Feistel sampler composed with a
+    seeded chaos plan stays end-to-end deterministic — two runs agree
+    bitwise on the sampled cohorts, the participation masks, AND the final
+    model. Recorded at the stage_fn seam both drive loops share."""
+    def run():
+        cfg = FedConfig(dataset="mnist", model="lr", comm_round=3,
+                        batch_size=8, lr=0.05, client_num_in_total=16,
+                        client_num_per_round=8, seed=0, fast_sampling=True)
+        trainer = ClassificationTrainer(
+            create_model("lr", output_dim=ds16.class_num))
+        api = FedAvgAPI(ds16, cfg, trainer)
+        staged = {}
+        orig = api.stage_fn
+
+        def recording(round_idx, **kw):
+            cohort = orig(round_idx, **kw)
+            staged[round_idx] = (
+                np.asarray(cohort.client_idx).copy(),
+                None if cohort.faults is None
+                else np.asarray(cohort.faults.participation).copy())
+            return cohort
+
+        api.stage_fn = recording
+        api.train(chaos=FaultPlan(seed=4, drop_rate=0.3, nan_rate=0.2))
+        return api.global_variables, staged
+
+    g1, s1 = run()
+    g2, s2 = run()
+    assert sorted(s1) == sorted(s2) == [0, 1, 2]
+    for r in s1:
+        idx1, mask1 = s1[r]
+        idx2, mask2 = s2[r]
+        np.testing.assert_array_equal(idx1, idx2)       # same cohort
+        np.testing.assert_array_equal(mask1, mask2)     # same chaos mask
+        assert len(idx1) == 8 and len(set(idx1.tolist())) == 8
+    assert _bitwise_equal(g1, g2)
+    assert _all_finite(g1)
+    # the composed schedule actually exercised a drop somewhere
+    assert any(not s1[r][1].all() for r in s1)
+
+
 # ---------------------------------------------------------------- round guard
 
 def test_round_guard_verdicts():
@@ -454,7 +496,7 @@ def test_retry_full_jitter_uses_injected_rng():
     assert isinstance(ei.value.last, ConnectionError)
 
 
-def test_retry_deadline_stops_early():
+def test_retry_deadline_clamps_then_stops():
     clock = _FakeClock()
     policy = RetryPolicy(max_attempts=10, base_delay=4.0, multiplier=2.0,
                          max_delay=100.0, jitter=False, deadline=10.0,
@@ -467,9 +509,34 @@ def test_retry_deadline_stops_early():
 
     with pytest.raises(RetryError) as ei:
         call_with_retry(fn, policy=policy, sleep=clock.sleep, clock=clock)
-    # sleeps 4, then 8 would overshoot the 10s deadline -> stop at attempt 2
-    assert clock.sleeps == [4.0]
-    assert ei.value.attempts == 2
+    # sleeps 4, then the 8s draw is CLAMPED to the 6s remaining budget (the
+    # deadline buys a third attempt instead of being forfeited); at t=10 no
+    # budget remains -> stop at attempt 3
+    assert clock.sleeps == [4.0, 6.0]
+    assert calls == [0.0, 4.0, 10.0]
+    assert ei.value.attempts == 3
+
+
+def test_retry_deadline_never_overshot_even_with_jitter():
+    """Regression for the backoff-overshoot bug: whatever the jitter draws,
+    total sleep never exceeds the deadline — on the injected clock the loop
+    lands exactly on it, not past it."""
+    clock = _FakeClock()
+    policy = RetryPolicy(max_attempts=10, base_delay=8.0, multiplier=2.0,
+                         max_delay=100.0, jitter=True, deadline=10.0,
+                         retryable=(ConnectionError,))
+
+    def fn():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError) as ei:
+        call_with_retry(fn, policy=policy, sleep=clock.sleep, clock=clock,
+                        rng=_FixedRng(1.0))  # jitter always draws the cap
+    # draws 8 (fits), then 16 clamped to the 2s remaining
+    assert clock.sleeps == [8.0, 2.0]
+    assert sum(clock.sleeps) == policy.deadline
+    assert clock() == 10.0  # never slept past the deadline
+    assert ei.value.attempts == 3
 
 
 def test_retry_non_retryable_passes_through():

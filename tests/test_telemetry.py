@@ -104,6 +104,10 @@ _SAMPLE_EVENTS = {
     "mqtt_reconnect": dict(client_id="c0", ok=True, attempts=2),
     "compile_cache": dict(name="persistent_cache_hit"),
     "round_fn_built": dict(program="engine.round", donate=True),
+    "update_admitted": dict(round=3, birth=1, fill=2),
+    "buffer_committed": dict(round=3, size=4, staleness_p50=1.0,
+                             staleness_max=2.0),
+    "download_retry": dict(attempt=0, status="503", backoff_s=1.5),
 }
 
 
@@ -344,3 +348,54 @@ def test_newest_bench_skips_shard_schema_by_name(tmp_path):
     with open(tmp_path / "BENCH_SHARD_r99.json", "w") as f:
         json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
     assert newest_bench(str(tmp_path)) is None
+
+
+def test_newest_bench_skips_buffered_schema_by_name(tmp_path):
+    """BENCH_BUFF_* measures committed-updates/s under a synthetic straggler
+    barrier, not drive throughput — skipped by NAME like SCALE and SHARD."""
+    with open(tmp_path / "BENCH_BUFF_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 12.5}}, f)
+    path, parsed = newest_bench(str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r02.json"
+    assert parsed["rounds_per_sec"] == 12.5
+
+
+# --------------------------------------------------- download-retry ledger
+
+def test_download_retry_emits_schema_checked_events(tmp_path):
+    """data/acquire retries leave download_retry ledger lines through the
+    telemetry seam: attempt index, HTTP code or failure class, and the
+    exact backoff actually slept."""
+    import urllib.error
+
+    from fedml_tpu.data.acquire import _download
+    from fedml_tpu.robustness.retry import RetryPolicy
+
+    calls = {"n": 0}
+
+    def fetcher(url, dst):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise urllib.error.HTTPError(url, 503, "unavailable", None, None)
+        if calls["n"] == 2:
+            raise ConnectionResetError("peer reset")
+        open(dst, "wb").close()
+
+    sleeps = []
+    t = Tracer()
+    telemetry.install(t)
+    try:
+        _download("http://example.invalid/a", str(tmp_path / "a"),
+                  fetcher=fetcher,
+                  policy=RetryPolicy(max_attempts=4, base_delay=1.0,
+                                     jitter=False, retryable=(OSError,)),
+                  sleep=sleeps.append)
+    finally:
+        telemetry.uninstall(t)
+    events = t.find_events("download_retry")
+    assert [e["attempt"] for e in events] == [0, 1]
+    assert [e["status"] for e in events] == ["503", "ConnectionResetError"]
+    assert [e["backoff_s"] for e in events] == sleeps == [1.0, 2.0]
+    assert calls["n"] == 3  # third call succeeded — no further retries

@@ -1,0 +1,168 @@
+"""Buffered-vs-barrier throughput under a seeded straggler plan.
+
+The claim under test (docs/PERF.md r14, ROADMAP item 3): with stragglers in
+the cohort, a synchronous round barrier stalls on the slowest client, while
+the buffered loop (algorithms/buffered.py) admits updates as they arrive and
+commits every K — so *committed client updates per wall-second* stays near
+the straggler-free rate instead of dividing by the tail latency.
+
+Both arms run the same workload (mnist/lr, 16 clients, cohort 8) and the
+same seeded straggler plan (FaultPlan.latencies — pure in (seed, round)):
+
+  sync_barrier  the synchronous drive loop, which has no latency concept,
+                plus an explicit per-round barrier sleep of
+                max(latency) * unit_s — the round cannot commit until its
+                slowest client returns. unit_s (one latency unit = one
+                dispatch round of compute) is calibrated from the warmup
+                sync run's mean round time, so the penalty is the time the
+                barrier would actually spend waiting on this box.
+  buffered      algorithms/buffered.train_buffered with the plan armed:
+                stragglers defer their arrival round, nobody sleeps, late
+                updates land staleness-discounted. Measured wall time is
+                real (includes the post-drive drain commits).
+
+Env knobs:
+  BENCH_BUFF_ROUNDS=30                dispatch rounds per arm
+  BENCH_BUFF_OUT=BENCH_BUFF_r01.json  '' to skip the artifact
+
+The artifact's `parsed` block deliberately has NO top-level
+`rounds_per_sec` and no `arms["0"]`: telemetry.report.baseline_rounds_per_sec
+must keep reading the drive-loop BENCH_rXX artifacts, and the gate skips
+BENCH_BUFF_* by name besides — committed-updates/s under a synthetic
+barrier is not a drive-throughput baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# geometry: small on purpose — the contrast is barrier-vs-buffered schedule,
+# not compute scale, and CI re-runs this on a capped CPU box
+CLIENTS, CPR, BATCH, BUFFER_K, ALPHA = 16, 8, 8, 8, 0.5
+STRAGGLER = dict(seed=7, straggler_rate=0.5, straggler_rounds=3)
+
+
+def _build_api(ds, rounds: int, buffered: bool):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    cfg = FedConfig(dataset="mnist", model="lr", comm_round=rounds,
+                    batch_size=BATCH, epochs=1, lr=0.05,
+                    client_num_in_total=CLIENTS, client_num_per_round=CPR,
+                    seed=0, ci=1, frequency_of_the_test=10**9,
+                    buffer_size=BUFFER_K if buffered else 0,
+                    staleness_alpha=ALPHA)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    return FedAvgAPI(ds, cfg, trainer)
+
+
+def run_sync_arm(ds, rounds: int, plan, unit_s: float) -> dict:
+    """Synchronous drive + explicit barrier sleep: round r cannot commit
+    until its slowest client returns, max(latencies(r)) * unit_s later."""
+    api = _build_api(ds, rounds, buffered=False)
+    barrier_s = 0.0
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        # train_one_round's metrics_fetch is one blocking device_get, so the
+        # compute part of the measurement is completed work, not dispatch
+        api.train_one_round(r)
+        stall = float(plan.latencies(r, CPR).max()) * unit_s
+        if stall > 0.0:
+            time.sleep(stall)
+        barrier_s += stall
+    wall_s = time.perf_counter() - t0
+    committed = rounds * CPR
+    return {
+        "committed_updates": committed,
+        "wall_s": round(wall_s, 4),
+        "barrier_sleep_s": round(barrier_s, 4),
+        "committed_updates_per_sec": round(committed / wall_s, 2),
+    }
+
+
+def run_buffered_arm(ds, rounds: int, plan) -> dict:
+    """Buffered drive with the straggler plan armed — no sleeps anywhere;
+    wall time includes the post-drive drain of outstanding arrivals."""
+    api = _build_api(ds, rounds, buffered=True)
+    t0 = time.perf_counter()
+    api.train(chaos=plan)
+    wall_s = time.perf_counter() - t0
+    host = api._buffer_host
+    return {
+        "committed_updates": host.committed_updates,
+        "commits": host.commits,
+        "wall_s": round(wall_s, 4),
+        "committed_updates_per_sec": round(
+            host.committed_updates / wall_s, 2),
+    }
+
+
+def main() -> None:
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.robustness.chaos import FaultPlan
+
+    rounds = int(os.environ.get("BENCH_BUFF_ROUNDS", 30))
+    ds = load_dataset("mnist", client_num_in_total=CLIENTS,
+                      partition_method="homo", seed=0)
+    plan = FaultPlan(**STRAGGLER)
+
+    # warmup: compile both arms' programs outside any timed window; the
+    # sync warmup doubles as the barrier-unit calibration (mean round time)
+    warm = _build_api(ds, rounds, buffered=False)
+    warm.train_one_round(0)
+    t0 = time.perf_counter()
+    for r in range(1, rounds):
+        warm.train_one_round(r)
+    unit_s = (time.perf_counter() - t0) / max(rounds - 1, 1)
+    run_buffered_arm(ds, 2, plan)
+
+    sync = run_sync_arm(ds, rounds, plan, unit_s)
+    buff = run_buffered_arm(ds, rounds, plan)
+
+    cores = os.cpu_count() or 1
+    parsed = {
+        "metric": "buffered_committed_updates_per_sec",
+        "unit": "committed client updates per wall-second under a seeded "
+                "straggler plan (sync arm pays an explicit barrier sleep)",
+        "arms": {"sync_barrier": sync, "buffered": buff},
+        "speedup": round(buff["committed_updates_per_sec"]
+                         / sync["committed_updates_per_sec"], 3),
+        "barrier_unit_s": round(unit_s, 4),
+        "straggler": dict(STRAGGLER),
+        "rounds": rounds, "clients": CLIENTS, "clients_per_round": CPR,
+        "batch_size": BATCH, "buffer_size": BUFFER_K,
+        "staleness_alpha": ALPHA, "model": "lr",
+        "platform": jax.devices()[0].platform,
+        "cpu_cores": cores,
+        "cpu_capped": cores < 2,
+    }
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_BUFF_OUT", "BENCH_BUFF_r01.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": rounds,
+                       "cmd": "python tools/bench_buffered.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
