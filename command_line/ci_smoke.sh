@@ -73,6 +73,29 @@ assert any(f.rule == "comms-budget" and f.target == name
 print("OK comms budget trips on tensor.round regression")
 EOF
 
+echo "== graft-lint compile layer (retrace budgets vs COMPILE_BUDGET.json)"
+# enumerates every jit entry point reachable from each drive config and
+# pins the exact compiled-program counts, plus the AST retrace-risk /
+# use-after-donate / rng-key-reuse / lock-discipline sweep; COMPILE.json
+# is the machine report next to LINT.json and COMMS.json
+python -m fedml_tpu.analysis --compile --json COMPILE.json
+
+echo "== compile budget self-test: an extra compile over the ceiling must trip"
+# fold a synthetic trace with one more compile request than the pipelined
+# drive's measured max_compiles — run_compile_gate must FAIL, proving the
+# runtime half of the budget gate is live
+python - <<'EOF'
+import json
+from fedml_tpu.telemetry.report import fold, run_compile_gate
+budgets = json.load(open("COMPILE_BUDGET.json"))
+n = budgets["pipelined"]["max_compiles"] + 1
+records = [{"type": "event", "kind": "compile_cache",
+            "name": "/jax/compilation_cache/compile_requests_use_cache"}] * n
+ok, skipped, msg = run_compile_gate(fold(records), budgets, "pipelined")
+assert not ok and not skipped, msg
+print("OK compile gate trips on one compile over the pipelined ceiling")
+EOF
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 
@@ -149,7 +172,7 @@ grep -Eq '^phase +count +total_s +p50_ms +p95_ms' /tmp/ci_smoke_trace_stdout.txt
 grep -Eq '^dispatch ' /tmp/ci_smoke_trace_stdout.txt
 python - "$RUN_DIR" <<'EOF'
 import sys
-from fedml_tpu.telemetry.report import fold, load_trace
+from fedml_tpu.telemetry.report import fold, load_trace, run_compile_gate
 report = fold(load_trace(f"{sys.argv[1]}/TRACE.jsonl"))
 assert report["coverage"] >= 0.95, f"span coverage {report['coverage']} < 0.95"
 assert report["rounds"] == 2, report["rounds"]
@@ -159,6 +182,15 @@ assert ev.get("guard_verdict", 0) >= 2, ev
 assert ev.get("round_committed", 0) == 2, ev
 assert ev.get("checkpoint_save", 0) >= 1, ev
 print(f"OK trace: coverage={report['coverage']} events={ev}")
+
+# compile gate: this drive IS the budgeted "pipelined" config (2 rounds of
+# it), so its traced compile count must fit under the measured 10-round
+# ceiling in COMPILE_BUDGET.json — any excess is a retracing call site
+import json
+budgets = json.load(open("COMPILE_BUDGET.json"))
+ok, skipped, msg = run_compile_gate(report, budgets, "pipelined")
+print(msg)
+assert ok and not skipped, msg
 EOF
 
 echo "== buffered straggler smoke (FedBuff drive: no round barrier, depth-2)"

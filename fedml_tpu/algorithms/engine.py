@@ -506,7 +506,9 @@ def build_chunked_round_runner(trainer, cfg: FedConfig, aggregator,
         for k0 in range(0, cfg.epochs, epoch_chunk):
             stacked, opt_state, steps, metrics = chunk_fn(
                 stacked, opt_state, steps, global_variables["params"],
+                # graft-lint: disable=retrace-risk -- at most TWO chunk geometries by construction (full chunks + one remainder), both compiled on round one and cached for the drive
                 x, y, counts, erngs[:, k0:k0 + epoch_chunk])
+        # graft-lint: disable=rng-key-reuse -- mirrors the monolithic round bit-for-bit: clients consume split(rng) streams inside the chunks while the aggregator consumes the raw round key in _finish, exactly as build_round_fn_from_update does in-graph
         return finish_fn(global_variables, agg_state, stacked, steps,
                          metrics, counts, rng)
 

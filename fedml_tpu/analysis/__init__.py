@@ -1,6 +1,6 @@
 """graft-lint — static analysis for jitted federated rounds.
 
-Three engines over one findings contract (``core.Finding``):
+Four engines over one findings contract (``core.Finding``):
 
 - **jaxpr engine** (`jaxpr_engine`): walks ClosedJaxprs of the repo's jitted
   callables (round runners, aggregator steps, every registry model's apply)
@@ -18,6 +18,15 @@ Three engines over one findings contract (``core.Finding``):
   collectives, partitioner resharding, ppermute coverage, unweighted
   psum means, axis-name mismatches — gated per program against
   COMMS_BUDGET.json (``--comms`` on the CLI).
+- **compile engine** (`compile_engine`): compile-count and thread/liveness
+  discipline — retrace-risk call sites (Python scalars / weak-typed
+  literals / shape-varying operands into jitted callables),
+  use-after-donate dataflow over the drive loops, lock-discipline for
+  state shared with the prefetch stager thread, rng-key-reuse — plus the
+  drive-config program-count budget: `targets.enumerate_drive_programs`
+  vs COMPILE_BUDGET.json statically (``--compile`` on the CLI) and
+  `telemetry.report.run_compile_gate` vs a traced run's compile_cache
+  events at runtime.
 
 `targets` names what gets linted (the repo's lintable surface);
 `partition` holds the PartitionSpec rule table and the coverage rule;
@@ -39,6 +48,12 @@ from fedml_tpu.analysis.jaxpr_engine import (
     walk_eqns,
 )
 from fedml_tpu.analysis.ast_engine import lint_source, lint_tree
+from fedml_tpu.analysis.compile_engine import (
+    check_budgets as check_compile_budgets,
+    lint_compile_source,
+    load_budgets as load_compile_budgets,
+    run_compile,
+)
 from fedml_tpu.analysis.hlo_engine import (
     analyze_program,
     check_accidental_replication,
@@ -67,6 +82,10 @@ __all__ = [
     "check_retrace",
     "lint_source",
     "lint_tree",
+    "lint_compile_source",
+    "run_compile",
+    "check_compile_budgets",
+    "load_compile_budgets",
     "parse_hlo_text",
     "shape_bytes",
     "collective_inventory",

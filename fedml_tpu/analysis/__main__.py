@@ -14,6 +14,14 @@ the flag surface:
   (repeatable) lowers only matching programs; `--update-budgets` rewrites
   the budget table from measurement instead of gating. `--json` writes
   COMMS.json (the comms report) rather than LINT.json.
+- `--compile`: the compile layer — run the compile-discipline AST rules
+  (retrace-risk, use-after-donate, lock-discipline, rng-key-reuse) over
+  the tree, enumerate every drive config's reachable XLA programs, and
+  gate the counts against COMPILE_BUDGET.json. `--fast` enumerates only
+  the four runtime drive configs; `--target DRIVE` (repeatable) picks
+  drives; `--update-budgets` rewrites the pins (and, unless --fast,
+  re-measures each runtime config's max_compiles ceiling with a traced
+  10-round subprocess drive — minutes). `--json` writes COMPILE.json.
 
 Run from anywhere — the repo root is derived from the package location.
 """
@@ -42,18 +50,48 @@ def main(argv=None) -> int:
     p.add_argument("--comms", action="store_true",
                    help="run the HLO layer instead: collective-traffic + "
                         "memory budget analysis of every parallel round")
+    p.add_argument("--compile", action="store_true", dest="compile_layer",
+                   help="run the compile layer instead: compile-discipline "
+                        "AST rules + drive-config program counts gated "
+                        "against COMPILE_BUDGET.json")
     p.add_argument("--target", action="append", metavar="SUBSTR",
                    help="(--comms) only lower programs whose name contains "
-                        "SUBSTR; repeatable")
+                        "SUBSTR; (--compile) only these drive configs; "
+                        "repeatable")
     p.add_argument("--update-budgets", action="store_true",
-                   help="(--comms) rewrite COMMS_BUDGET.json from the "
-                        "measured traffic instead of gating against it")
+                   help="(--comms/--compile) rewrite the budget file from "
+                        "measurement instead of gating against it")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.compile_layer:
+        # same mesh contract as --comms: the tensor/sharded/hierarchical
+        # drive programs need 8 virtual devices, set before jax initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+        from fedml_tpu.analysis.compile_engine import (format_compile_table,
+                                                       load_budgets,
+                                                       run_compile)
+
+        report, measured = run_compile(
+            repo_root, fast=args.fast, targets=args.target,
+            update_budgets=args.update_budgets,
+            measure=args.update_budgets and not args.fast)
+        if args.json:
+            out = {"drives": measured, "lint": report.to_dict()}
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=2)
+                f.write("\n")
+        print(format_compile_table(measured, load_budgets(repo_root)))
+        print(report.summary())
+        return 0 if report.ok else 1
 
     if args.comms:
         # must land before jax initializes its backend — run_comms re-checks

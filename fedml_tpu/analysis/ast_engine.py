@@ -616,6 +616,10 @@ def lint_source(source: str, path: str) -> List[Finding]:
     if "algorithms" in path.replace(os.sep, "/").split("/"):
         _DriveLoopFetch(path, lines, findings).visit(tree)
         _NakedTimer(path, lines, findings).visit(tree)
+    # compile-layer rules (engine #4) ride the same sweep so LINT.json and
+    # the repo-clean pins cover them; late import avoids a module cycle
+    from fedml_tpu.analysis.compile_engine import lint_compile_tree
+    findings.extend(lint_compile_tree(tree, path, lines))
     for lineno, rules, reason in iter_suppressions(source):
         if reason is None and not is_suppressed(lines, lineno,
                                                 "bare-suppression"):

@@ -1,0 +1,847 @@
+"""graft-lint engine #4: the compile layer.
+
+Three rule families around the jitted drive loops, plus the CI-pinned
+compile budget:
+
+* **retrace budget** — `enumerate_drive_programs()` (targets.py) counts the
+  distinct XLA programs each registered drive config reaches;
+  `COMPILE_BUDGET.json` pins those counts exactly (two-way: an un-budgeted
+  program and a stale pin are both findings) and, for the runtime drive
+  configs, a `max_compiles` ceiling that `telemetry.report.run_compile_gate`
+  checks against graft-trace's `compile_cache` events. The AST-side
+  `retrace-risk` rule flags call sites that feed Python scalars, weak-typed
+  literals, or shape-varying operands into jitted callables — each distinct
+  value/shape is a fresh compile.
+* **use-after-donate** — linear dataflow over each function body tracking
+  the expressions passed at donated argnums (through `jax.jit(...,
+  donate_argnums=...)` bindings and the repo's `build_*` factory
+  conventions); any later read/len/indexing of the donated binding is a
+  finding. Re-binding the donated value from the call's own result (the
+  `stacked, ... = chunk_fn(stacked, ...)` idiom) blesses it.
+* **lock-discipline** — for every class that owns a `threading.Lock`/`RLock`,
+  attributes are *guarded* if any method touches them under `with
+  self._lock` and *shared* if written outside ``__init__``; touching a
+  guarded+shared attribute outside the lock (including from a nested
+  function handed to the stager thread, which never inherits the caller's
+  lock) is a finding. A method whose every in-class call site holds the
+  lock is lock-held by propagation, like the AST engine's traced-ness.
+* **rng-key-reuse** — a PRNG key variable (assigned from
+  `PRNGKey/fold_in/split`, or an `rng`/`key` parameter) consumed by two
+  jitted calls without an intervening `fold_in`/`split` reuses identical
+  randomness; consumption inside a loop whose key was minted outside is
+  flagged immediately.
+
+The budget half mirrors analysis/comms.py: `load_budgets` / `make_budgets` /
+`check_budgets` / `run_compile`, JSON written deterministically so
+`--update-budgets` round-trips byte-stable.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from fedml_tpu.analysis.core import Finding, Report, is_suppressed
+
+BUDGET_FILE = "COMPILE_BUDGET.json"
+
+# Drive configs whose compile counts are measured at runtime (10-round CLI
+# drives under graft-trace) in addition to the static enumeration. The CLI
+# fragments double as documentation of what each budget entry pins.
+RUNTIME_DRIVE_CLI = {
+    "eager": "--comm_round 10",
+    "pipelined": ("--comm_round 10 --pipeline_depth 2 --chaos 1 "
+                  "--chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 "
+                  "--guard 1"),
+    "buffered": ("--comm_round 10 --pipeline_depth 2 --buffer_size 5 "
+                 "--staleness_alpha 0.5 --chaos_straggler_rate 0.5 "
+                 "--chaos_straggler_rounds 2"),
+    "tensor": "--comm_round 10 --tensor_shards 4",
+}
+
+# ---------------------------------------------------------------------------
+# jit-binding collection (shared by retrace-risk / use-after-donate /
+# rng-key-reuse)
+# ---------------------------------------------------------------------------
+
+# Factories following the repo's build_* convention whose results donate
+# input buffers. Values are the donated argnums of the *returned* callable;
+# donation is active only when the donate_* keyword is passed and is not a
+# literal False (a non-literal toggle is treated as donating — conservative).
+_DONATING_FACTORIES = {
+    "build_round_fn": ("donate_data", (2, 3, 4)),
+    "build_round_fn_from_update": ("donate_data", (2, 3, 4)),
+    "build_tensor_round_fn": ("donate_data", (2, 3, 4)),
+    "build_client_step_fn": ("donate_data", (1, 2)),
+    "build_buffer_admit": ("donate_buffer", (0,)),
+}
+
+_KEY_SOURCES = {"PRNGKey", "fold_in", "split", "key", "wrap_key_data"}
+
+
+def _dotted(node) -> Optional[str]:
+    """'jax.jit' for Attribute chains, 'jit' for Names (ast_engine's)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _JitBindings:
+    """Names/attrs in a module bound to jitted callables, with donation info.
+
+    `names` / `attrs`: bare names and attribute tails (``self.round_fn`` ->
+    ``round_fn``) whose RHS was `jax.jit(...)`, `pjit(...)`, or a call to a
+    `build_*` factory (the repo convention: factories return jitted
+    callables).  `donating` / `donating_attrs` map the subset with known
+    donated argnums.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.names: set = set()
+        self.attrs: set = set()
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self.donating_attrs: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                key, attr = target.id, False
+            elif isinstance(target, ast.Attribute):
+                key, attr = target.attr, True
+            else:
+                continue
+            argnums = self._jit_rhs(node.value)
+            if argnums is None:
+                continue
+            (self.attrs if attr else self.names).add(key)
+            if argnums:
+                (self.donating_attrs if attr else self.donating)[key] = argnums
+
+    @staticmethod
+    def _jit_rhs(value) -> Optional[Tuple[int, ...]]:
+        """None: not a jit binding. (): jitted, no known donation.
+        (i, ...): jitted with those donated argnums."""
+        if not isinstance(value, ast.Call):
+            return None
+        tail = (_dotted(value.func) or "").rsplit(".", 1)[-1]
+        if tail in ("jit", "pjit"):
+            for kw in value.keywords:
+                if kw.arg == "donate_argnums":
+                    lits = _literal_int_tuple(kw.value)
+                    return lits if lits else ()
+            return ()
+        if tail in _DONATING_FACTORIES:
+            toggle, argnums = _DONATING_FACTORIES[tail]
+            for kw in value.keywords:
+                if kw.arg == toggle and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return argnums
+            return ()
+        if tail.startswith("build_"):
+            return ()
+        return None
+
+    def callee(self, func) -> Optional[str]:
+        """Dotted callee string if `func` refers to a jit binding."""
+        d = _dotted(func)
+        if d is None:
+            return None
+        tail = d.rsplit(".", 1)[-1]
+        if (isinstance(func, ast.Name) and d in self.names) or (
+                isinstance(func, ast.Attribute) and tail in self.attrs):
+            return d
+        return None
+
+    def donated_argnums(self, func) -> Optional[Tuple[int, ...]]:
+        d = _dotted(func)
+        if d is None:
+            return None
+        if isinstance(func, ast.Name):
+            return self.donating.get(d)
+        return self.donating_attrs.get(d.rsplit(".", 1)[-1])
+
+
+def _literal_int_tuple(node) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# ordered statement/expression event stream (use-after-donate, rng-key-reuse)
+# ---------------------------------------------------------------------------
+
+
+def _iter_events(body: Sequence[ast.stmt], depth: int = 0):
+    """Yield ('stmt'|'expr', node, loop_depth) in source order. Compound
+    statements contribute their header expressions then recurse; nested
+    def/class scopes are skipped (they run at call time, not here)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            yield ("expr", stmt.iter, depth)
+            yield from _iter_events(stmt.body + stmt.orelse, depth + 1)
+        elif isinstance(stmt, ast.While):
+            yield ("expr", stmt.test, depth + 1)
+            yield from _iter_events(stmt.body + stmt.orelse, depth + 1)
+        elif isinstance(stmt, ast.If):
+            yield ("expr", stmt.test, depth)
+            yield from _iter_events(stmt.body, depth)
+            yield from _iter_events(stmt.orelse, depth)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                yield ("expr", item.context_expr, depth)
+            yield from _iter_events(stmt.body, depth)
+        elif isinstance(stmt, ast.Try):
+            yield from _iter_events(stmt.body, depth)
+            for h in stmt.handlers:
+                yield from _iter_events(h.body, depth)
+            yield from _iter_events(stmt.orelse, depth)
+            yield from _iter_events(stmt.finalbody, depth)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            continue
+        else:
+            yield ("stmt", stmt, depth)
+
+
+def _assign_targets(stmt) -> List[str]:
+    """Dotted strings bound by this statement (tuple targets flattened)."""
+    out = []
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                d = _dotted(e)
+                if d:
+                    out.append(d)
+        else:
+            d = _dotted(t)
+            if d:
+                out.append(d)
+    return out
+
+
+def _calls_in(node) -> List[ast.Call]:
+    return [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+
+
+# ---------------------------------------------------------------------------
+# retrace-risk
+# ---------------------------------------------------------------------------
+
+
+def _const_expr(node) -> bool:
+    if node is None or isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return isinstance(node.operand, ast.Constant)
+    return False
+
+
+def _retrace_risk_arg(arg) -> Optional[str]:
+    """Reason string if `arg` is a retrace hazard when fed to a jitted fn."""
+    if isinstance(arg, ast.Constant) and type(arg.value) in (bool, int, float):
+        return (f"Python scalar literal {arg.value!r} is weak-typed — a "
+                "second call site passing an array (or a different literal) "
+                "retraces; wrap with np.int32/jnp.asarray or close over it")
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+            and arg.func.id in ("float", "int", "bool"):
+        return (f"{arg.func.id}(...) feeds a weak-typed Python scalar into "
+                "a jitted call — every distinct value is a fresh compile")
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Subscript):
+            slices = sub.slice.elts if isinstance(sub.slice, ast.Tuple) \
+                else [sub.slice]
+            for s in slices:
+                if isinstance(s, ast.Slice) and not all(
+                        _const_expr(b) for b in (s.lower, s.upper, s.step)):
+                    return ("shape-varying operand: slice bounds are not "
+                            "constant, so every distinct extent is a fresh "
+                            "compile — pad to a static shape")
+    return None
+
+
+def _lint_retrace_risk(fn_body, bindings: _JitBindings, path: str,
+                       lines: List[str]) -> List[Finding]:
+    findings = []
+    for kind, node, _ in _iter_events(fn_body):
+        for call in _calls_in(node):
+            callee = bindings.callee(call.func)
+            if callee is None:
+                continue
+            exprs = [a for a in call.args
+                     if not isinstance(a, ast.Starred)]
+            exprs += [kw.value for kw in call.keywords if kw.arg]
+            for arg in exprs:
+                reason = _retrace_risk_arg(arg)
+                if reason is None:
+                    continue
+                lineno = getattr(arg, "lineno", call.lineno)
+                if is_suppressed(lines, lineno, "retrace-risk"):
+                    continue
+                findings.append(Finding(
+                    rule="retrace-risk",
+                    target=f"{path}:{lineno}",
+                    message=f"call to jitted `{callee}`: {reason}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+
+
+def _lint_use_after_donate(fn_body, bindings: _JitBindings, path: str,
+                           lines: List[str]) -> List[Finding]:
+    findings = []
+    live: Dict[str, Tuple[str, int]] = {}   # donated dotted -> (callee, line)
+    list_values: Dict[str, List[ast.expr]] = {}
+
+    def reads_of(node):
+        for sub in ast.walk(node):
+            d = _dotted(sub) if isinstance(sub, (ast.Name, ast.Attribute)) \
+                else None
+            if d is None:
+                continue
+            if not isinstance(getattr(sub, "ctx", None), ast.Load):
+                continue
+            for b in list(live):
+                if d == b or d.startswith(b + "."):
+                    yield b, sub
+
+    def check_reads(node):
+        for b, sub in reads_of(node):
+            callee, dline = live.pop(b)
+            if is_suppressed(lines, sub.lineno, "use-after-donate"):
+                continue
+            findings.append(Finding(
+                rule="use-after-donate",
+                target=f"{path}:{sub.lineno}",
+                message=(f"`{b}` was donated to `{callee}` at line {dline} "
+                         "— the buffer is dead (XLA may already have reused "
+                         "it); re-bind the result or drop the read")))
+
+    for kind, node, _ in _iter_events(fn_body):
+        check_reads(node)
+
+        if kind != "stmt":
+            continue
+        targets = _assign_targets(node)
+        # assignment to (or through the root of) a donated binding kills it
+        for t in targets:
+            for b in list(live):
+                if b == t or b.startswith(t + ".") or t.startswith(b + "."):
+                    del live[b]
+
+        # model `args = [...]` / `args.append(x)` so `fn(*args)` resolves
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.List):
+            list_values[node.targets[0].id] = list(node.value.elts)
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "append" \
+                and isinstance(node.value.func.value, ast.Name) \
+                and node.value.func.value.id in list_values \
+                and node.value.args:
+            list_values[node.value.func.value.id].append(node.value.args[0])
+
+        for call in _calls_in(node):
+            argnums = bindings.donated_argnums(call.func)
+            if not argnums:
+                continue
+            pos_args = call.args
+            if len(pos_args) == 1 and isinstance(pos_args[0], ast.Starred) \
+                    and isinstance(pos_args[0].value, ast.Name):
+                pos_args = list_values.get(pos_args[0].value.id, [])
+            for i in argnums:
+                if i >= len(pos_args):
+                    continue
+                d = _dotted(pos_args[i])
+                if d is None or d in targets:   # re-binding idiom: blessed
+                    continue
+                live[d] = (bindings.callee(call.func) or _dotted(call.func)
+                           or "?", call.lineno)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rng-key-reuse
+# ---------------------------------------------------------------------------
+
+
+def _is_key_name(name: str) -> bool:
+    parts = name.lower().split("_")
+    return "rng" in parts or "key" in parts
+
+
+def _is_key_rhs(value) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    tail = (_dotted(value.func) or "").rsplit(".", 1)[-1]
+    return tail in _KEY_SOURCES
+
+
+def _lint_rng_key_reuse(fn_node, bindings: _JitBindings, path: str,
+                        lines: List[str]) -> List[Finding]:
+    findings = []
+    keys: Dict[str, Tuple[int, int]] = {}   # name -> (uses, bound_depth)
+    for a in list(fn_node.args.args) + list(fn_node.args.kwonlyargs):
+        if _is_key_name(a.arg):
+            keys[a.arg] = (0, 0)
+
+    def consume(node, depth):
+        for call in _calls_in(node):
+            if bindings.callee(call.func) is None:
+                continue
+            exprs = [a for a in call.args] + \
+                    [kw.value for kw in call.keywords]
+            seen = set()
+
+            def scan(n):
+                # a key inside fold_in(key, i)/split(key) is being DERIVED,
+                # not consumed raw — that is the blessed idiom
+                if isinstance(n, ast.Call) and (
+                        _dotted(n.func) or "").rsplit(
+                            ".", 1)[-1] in _KEY_SOURCES:
+                    return
+                if isinstance(n, ast.Name) and n.id in keys:
+                    seen.add(n.id)
+                for c in ast.iter_child_nodes(n):
+                    scan(c)
+
+            for e in exprs:
+                scan(e)
+            for name in seen:
+                uses, bound_depth = keys[name]
+                looped = depth > bound_depth
+                if uses >= 1 or looped:
+                    del keys[name]
+                    if is_suppressed(lines, call.lineno, "rng-key-reuse"):
+                        continue
+                    how = ("inside a loop without a per-iteration "
+                           "fold_in/split" if looped and uses == 0
+                           else "by a second jitted call without an "
+                                "intervening fold_in/split")
+                    findings.append(Finding(
+                        rule="rng-key-reuse",
+                        target=f"{path}:{call.lineno}",
+                        message=(f"PRNG key `{name}` is consumed {how} — "
+                                 "identical randomness on every use")))
+                else:
+                    keys[name] = (uses + 1, bound_depth)
+
+    for kind, node, depth in _iter_events(fn_node.body):
+        consume(node, depth)
+        if kind != "stmt" or not isinstance(node, ast.Assign):
+            continue
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _is_key_rhs(node.value):
+                keys[name] = (0, depth)     # fresh/refolded key
+            elif name in keys:
+                del keys[name]              # rebound to something else
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+class _Access:
+    __slots__ = ("attr", "lineno", "write", "locked", "nested", "method")
+
+    def __init__(self, attr, lineno, write, locked, nested, method):
+        self.attr, self.lineno = attr, lineno
+        self.write, self.locked = write, locked
+        self.nested, self.method = nested, method
+
+
+_MUTATORS = {"append", "extend", "pop", "popleft", "appendleft", "clear",
+             "update", "setdefault", "insert", "remove", "discard", "add",
+             "sort"}
+
+
+def _lock_attr_of(cls: ast.ClassDef) -> Optional[str]:
+    """Attr name assigned threading.Lock()/RLock() in __init__, if any."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__":
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and (_dotted(node.value.func) or "").rsplit(
+                            ".", 1)[-1] in ("Lock", "RLock") \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id == "self":
+                    return node.targets[0].attr
+    return None
+
+
+def _collect_accesses(method: ast.FunctionDef, lock_attr: str,
+                      self_name: str = "self"):
+    """(accesses, calls): attribute touches on `self` with lock context, and
+    (callee_method, locked) pairs for in-class calls."""
+    accesses: List[_Access] = []
+    calls: List[Tuple[str, bool]] = []
+
+    def walk(node, locked, nested, parent_store=False):
+        if isinstance(node, ast.With):
+            item_locked = locked
+            for item in node.items:
+                walk(item.context_expr, locked, nested)
+                if _dotted(item.context_expr) == f"{self_name}.{lock_attr}":
+                    item_locked = True
+            for stmt in node.body:
+                walk(stmt, item_locked, nested)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not method:
+                # nested def: runs later (e.g. on the stager thread) —
+                # the enclosing lock is NOT held when it executes.
+                for stmt in node.body:
+                    walk(stmt, False, True)
+                return
+            for stmt in node.body:
+                walk(stmt, locked, nested)
+            return
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id == self_name:
+                calls.append((node.func.attr, locked))
+                for a in node.args + [kw.value for kw in node.keywords]:
+                    walk(a, locked, nested)
+                return
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == self_name:
+                # self.X.append(...) — mutation through the attribute
+                accesses.append(_Access(
+                    base.attr, base.lineno,
+                    node.func.attr in _MUTATORS, locked, nested, method.name))
+                for a in node.args + [kw.value for kw in node.keywords]:
+                    walk(a, locked, nested)
+                return
+        if isinstance(node, ast.Subscript):
+            store = isinstance(node.ctx, (ast.Store, ast.Del))
+            walk(node.value, locked, nested, parent_store=store)
+            walk(node.slice, locked, nested)
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == self_name:
+            write = isinstance(node.ctx, (ast.Store, ast.Del)) or parent_store
+            accesses.append(_Access(
+                node.attr, node.lineno, write, locked, nested, method.name))
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, locked, nested)
+
+    walk(method, False, False)
+    return accesses, calls
+
+
+def _lint_lock_discipline(tree: ast.AST, path: str,
+                          lines: List[str]) -> List[Finding]:
+    findings = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        lock_attr = _lock_attr_of(cls)
+        if lock_attr is None:
+            continue
+        methods = [s for s in cls.body if isinstance(s, ast.FunctionDef)]
+        method_names = {m.name for m in methods}
+        per_method = {m.name: _collect_accesses(m, lock_attr)
+                      for m in methods}
+
+        # lock-held propagation: a method is lock-held when every in-class
+        # call site holds the lock (directly or via a lock-held caller).
+        call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+        for name, (_, calls) in per_method.items():
+            for callee, locked in calls:
+                call_sites.setdefault(callee, []).append((name, locked))
+        lock_held: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in method_names - lock_held - {"__init__"}:
+                sites = call_sites.get(name)
+                if sites and all(locked or caller in lock_held
+                                 for caller, locked in sites):
+                    lock_held.add(name)
+                    changed = True
+
+        def effective_locked(a: _Access) -> bool:
+            if a.nested:
+                return a.locked
+            return a.locked or a.method in lock_held
+
+        guarded, shared = set(), set()
+        for name, (accesses, _) in per_method.items():
+            for a in accesses:
+                if name != "__init__" and effective_locked(a):
+                    guarded.add(a.attr)
+                if a.write and name != "__init__":
+                    shared.add(a.attr)
+        hot = (guarded & shared) - method_names - {lock_attr}
+
+        seen = set()
+        for name, (accesses, _) in per_method.items():
+            if name == "__init__":
+                continue
+            for a in accesses:
+                if a.attr not in hot or effective_locked(a):
+                    continue
+                if (a.lineno, a.attr) in seen:
+                    continue
+                seen.add((a.lineno, a.attr))
+                if is_suppressed(lines, a.lineno, "lock-discipline"):
+                    continue
+                findings.append(Finding(
+                    rule="lock-discipline",
+                    target=f"{path}:{a.lineno}",
+                    message=(f"`self.{a.attr}` is guarded by "
+                             f"`self.{lock_attr}` elsewhere in "
+                             f"`{cls.name}` but touched here without it"
+                             + (" (nested function: the enclosing lock is "
+                                "not held when this runs)" if a.nested
+                                else ""))))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def _function_nodes(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def lint_compile_tree(tree: ast.AST, path: str,
+                      lines: List[str]) -> List[Finding]:
+    """Run the four compile-layer AST rules over a parsed module."""
+    bindings = _JitBindings(tree)
+    findings: List[Finding] = []
+    for fn in _function_nodes(tree):
+        findings.extend(_lint_retrace_risk(fn.body, bindings, path, lines))
+        findings.extend(_lint_use_after_donate(fn.body, bindings, path, lines))
+        findings.extend(_lint_rng_key_reuse(fn, bindings, path, lines))
+    findings.extend(_lint_lock_discipline(tree, path, lines))
+    return findings
+
+
+def lint_compile_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Standalone parse + compile-layer rules (fixture tests use this)."""
+    tree = ast.parse(source)
+    return lint_compile_tree(tree, path, source.splitlines())
+
+
+def lint_compile_file(path: str) -> List[Finding]:
+    with open(path) as f:
+        source = f.read()
+    try:
+        return lint_compile_source(source, path)
+    except SyntaxError as e:
+        return [Finding(rule="retrace-risk", target=f"{path}:{e.lineno}",
+                        message=f"could not parse: {e.msg}",
+                        severity="warning")]
+
+
+def lint_compile_dir(root: str,
+                     subdirs: Sequence[str] = ("fedml_tpu", "tools")
+                     ) -> List[Finding]:
+    findings = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, _, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_compile_file(os.path.join(dirpath, fn)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# compile budgets (mirrors analysis/comms.py's budget plumbing)
+# ---------------------------------------------------------------------------
+
+
+def load_budgets(repo_root: str) -> Dict:
+    path = os.path.join(repo_root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def make_budgets(measured: Dict[str, Dict[str, int]],
+                 existing: Optional[Dict] = None,
+                 max_compiles: Optional[Dict[str, int]] = None) -> Dict:
+    """Budget dict from measured program counts. Existing `max_compiles`
+    ceilings survive unless re-measured; keys are sorted so the JSON is
+    byte-stable across runs."""
+    existing = existing or {}
+    out = dict(existing)
+    for drive, programs in measured.items():
+        entry = {
+            "programs": dict(sorted(programs.items())),
+            "static_total": sum(programs.values()),
+        }
+        prev = existing.get(drive, {})
+        if max_compiles and drive in max_compiles:
+            entry["max_compiles"] = max_compiles[drive]
+        elif "max_compiles" in prev:
+            entry["max_compiles"] = prev["max_compiles"]
+        if drive in RUNTIME_DRIVE_CLI:
+            entry["cli"] = RUNTIME_DRIVE_CLI[drive]
+        out[drive] = entry
+    return dict(sorted(out.items()))
+
+
+def check_budgets(measured: Dict[str, Dict[str, int]],
+                  budgets: Dict) -> List[Finding]:
+    """Exact two-way check: every enumerated program must be pinned with the
+    same signature count, and every pin must still be reachable."""
+    findings = []
+    hint = "re-run `python -m fedml_tpu.analysis --compile --update-budgets`"
+    for drive, programs in sorted(measured.items()):
+        entry = budgets.get(drive)
+        if entry is None:
+            findings.append(Finding(
+                rule="compile-budget", target=f"drive:{drive}",
+                message=(f"no {BUDGET_FILE} entry for drive config "
+                         f"`{drive}` ({sum(programs.values())} program(s) "
+                         f"enumerated) — {hint}")))
+            continue
+        pinned = entry.get("programs", {})
+        for name, n in sorted(programs.items()):
+            if name not in pinned:
+                findings.append(Finding(
+                    rule="compile-budget", target=f"drive:{drive}",
+                    message=(f"program `{name}` is reachable but not "
+                             f"budgeted ({n} signature(s)) — {hint}")))
+            elif pinned[name] != n:
+                diff = n - pinned[name]
+                findings.append(Finding(
+                    rule="compile-budget", target=f"drive:{drive}",
+                    message=(f"program `{name}`: enumerated {n} "
+                             f"signature(s) != pinned {pinned[name]} "
+                             f"({diff:+d}) — {hint}")))
+        for name in sorted(set(pinned) - set(programs)):
+            findings.append(Finding(
+                rule="compile-budget", target=f"drive:{drive}",
+                message=(f"stale budget pin `{name}` — program is no "
+                         f"longer reachable from this drive config; "
+                         f"{hint}")))
+    return findings
+
+
+def format_compile_table(measured: Dict[str, Dict[str, int]],
+                         budgets: Dict) -> str:
+    lines = [f"{'drive':<14} {'programs':>8} {'signatures':>10} "
+             f"{'max_compiles':>12}"]
+    for drive, programs in sorted(measured.items()):
+        entry = budgets.get(drive, {})
+        mc = entry.get("max_compiles", "-")
+        lines.append(f"{drive:<14} {len(programs):>8} "
+                     f"{sum(programs.values()):>10} {str(mc):>12}")
+    return "\n".join(lines)
+
+
+def measure_drive_compiles(drive: str, repo_root: str,
+                           rounds: int = 10) -> int:
+    """Ground-truth compile count for a runtime drive config: run the CLI
+    drive in a fresh subprocess (jit caches are process-global, so in-process
+    back-to-back drives under-count) with graft-trace on, and count the
+    trace's compile-request events."""
+    import tempfile
+    cli = RUNTIME_DRIVE_CLI[drive].replace("--comm_round 10",
+                                           f"--comm_round {rounds}")
+    with tempfile.TemporaryDirectory() as td:
+        # main_fedavg's tracer always writes <run_dir>/TRACE.jsonl, and
+        # setup_run() turns the jax.monitoring -> compile_cache forwarding on
+        trace = os.path.join(td, "TRACE.jsonl")
+        cmd = [sys.executable, "-m", "fedml_tpu.experiments.main_fedavg",
+               "--run_dir", td, "--seed", "0",
+               "--dataset", "mnist", "--data_dir", "./data",
+               "--model", "lr", "--client_num_in_total", "8",
+               "--client_num_per_round", "8", "--epochs", "1",
+               "--batch_size", "4", "--frequency_of_the_test", "5",
+               ] + cli.split()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        subprocess.run(cmd, cwd=repo_root, env=env, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        from fedml_tpu.telemetry.report import load_trace
+        records = load_trace(trace)
+        return sum(1 for r in records
+                   if r.get("kind") == "compile_cache"
+                   and str(r.get("name", "")).endswith(
+                       "compile_requests_use_cache"))
+
+
+def run_compile(repo_root: str, fast: bool = False,
+                targets: Optional[Sequence[str]] = None,
+                update_budgets: bool = False,
+                measure: bool = False) -> Tuple[Report, Dict]:
+    """The --compile engine: AST compile rules over the tree + static
+    program enumeration vs COMPILE_BUDGET.json. With `measure`, also re-runs
+    the four runtime drive configs in subprocesses to refresh their
+    `max_compiles` ceilings (slow — minutes)."""
+    from fedml_tpu.analysis.targets import (DRIVE_CONFIGS,
+                                            enumerate_drive_programs)
+    report = Report()
+
+    report.extend(lint_compile_dir(repo_root))
+    report.mark("ast:compile-rules")
+
+    drives = list(targets) if targets else list(DRIVE_CONFIGS)
+    if fast:
+        drives = [d for d in drives if d in RUNTIME_DRIVE_CLI]
+    measured = {}
+    for drive in drives:
+        measured[drive] = enumerate_drive_programs(drive)
+        report.mark(f"drive:{drive}")
+
+    budgets = load_budgets(repo_root)
+    if update_budgets:
+        ceilings = None
+        if measure:
+            ceilings = {d: measure_drive_compiles(d, repo_root)
+                        for d in drives if d in RUNTIME_DRIVE_CLI}
+        budgets = make_budgets(measured, existing=budgets,
+                               max_compiles=ceilings)
+        with open(os.path.join(repo_root, BUDGET_FILE), "w") as f:
+            json.dump(budgets, f, indent=2)
+            f.write("\n")
+    report.extend(check_budgets(measured, budgets))
+    return report, measured

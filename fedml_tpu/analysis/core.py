@@ -42,6 +42,20 @@ RULES = {
                           "does not bind",
     "comms-budget": "program exceeds its COMMS_BUDGET.json collective/memory "
                     "ceiling (or has no budget entry)",
+    # Compile-layer rules (compile_engine): program-count and thread/liveness
+    # discipline around the jitted drive loops.
+    "compile-budget": "drive config compiles more distinct programs than its "
+                      "COMPILE_BUDGET.json pin (or has no budget entry)",
+    "retrace-risk": "call site feeds a Python scalar, weak-typed literal, or "
+                    "shape-varying operand into a jitted function (every "
+                    "distinct value/shape is a fresh compile)",
+    "use-after-donate": "value passed at a donated argnum is read again "
+                        "after the donating call (the buffer is dead — "
+                        "XLA may have already reused it)",
+    "lock-discipline": "stager-thread function touches shared mutable state "
+                       "outside a `with self._lock` block",
+    "rng-key-reuse": "PRNG key consumed by two jitted calls without an "
+                     "intervening fold_in/split (identical randomness)",
     "bare-suppression": "graft-lint: disable comment without a '-- reason'",
 }
 

@@ -300,6 +300,145 @@ def check_tensor_rule_coverage(rule_tables=None,
     return out
 
 
+# ------------------------------------------------------------------ drives
+# The registered drive configs whose XLA program sets COMPILE_BUDGET.json
+# pins (compile_engine). Each enumerator abstractly traces every jit entry
+# point the drive loop reaches and returns {program name: #signatures} —
+# tracing (not just listing) makes the enumeration crash the moment a
+# signature arm drifts from the real builders.
+DRIVE_CONFIGS = ("eager", "pipelined", "buffered", "tensor", "sharded",
+                 "hierarchical", "silo")
+
+
+def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
+    """The three eval programs every FedAvgAPI drive shares: packed global
+    eval, chunked per-client eval, and the resident federation eval (two
+    signatures — the Train and Test splits pack to different n_max)."""
+    from fedml_tpu.algorithms.engine import (build_client_eval_fn,
+                                             build_eval_fn,
+                                             build_federation_eval_fn)
+
+    feat = shape[1:]
+    i32 = lambda s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    xs = lambda s: jax.ShapeDtypeStruct(s + feat, in_dtype)  # noqa: E731
+    jax.eval_shape(build_eval_fn(trainer), gv,
+                   xs((3, 2)), i32((3, 2)), f32((3, 2)))
+    jax.eval_shape(build_client_eval_fn(trainer), gv,
+                   xs((2, 4)), i32((2, 4)), i32((2,)))
+    fed_eval = build_federation_eval_fn(trainer)
+    for n_max in (4, 6):
+        jax.eval_shape(fed_eval, gv,
+                       xs((1, 2, n_max)), i32((1, 2, n_max)), i32((1, 2)))
+    return {"engine.eval[lr,f32]": 1, "engine.client_eval[lr,f32]": 1,
+            "engine.federation_eval[lr,f32]": 2}
+
+
+def enumerate_drive_programs(drive: str) -> dict:
+    """{program name: distinct signature count} for one registered drive
+    config — the static half of the compile budget. All programs trace on
+    the lr/f32/fedavg example (signature COUNT does not depend on the
+    model), except silo which needs a conv model to group."""
+    from fedml_tpu.algorithms.aggregators import (build_buffer_admit,
+                                                  build_buffer_commit,
+                                                  make_aggregator,
+                                                  make_staleness_discount)
+    from fedml_tpu.algorithms.engine import build_round_fn
+
+    if drive not in DRIVE_CONFIGS:
+        raise ValueError(f"unknown drive config {drive!r}; "
+                         f"known: {sorted(DRIVE_CONFIGS)}")
+    trainer, shape, in_dtype = _tiny_trainer("lr", "float32")
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    agg = make_aggregator("fedavg", cfg)
+    gv, x, y, counts, rng = _abstract_round_args(trainer, shape, in_dtype)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    part = jax.ShapeDtypeStruct((2,), jnp.bool_)
+    programs = {}
+
+    if drive == "eager":
+        round_fn = build_round_fn(trainer, cfg, agg)
+        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
+        programs["engine.round[lr,f32,fedavg]"] = 1
+    elif drive == "pipelined":
+        # chaos is on for the pipelined config, so every round carries a
+        # participation mask — only the masked arm ever compiles
+        round_fn = build_round_fn(trainer, cfg, agg)
+        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng, part)
+        programs["engine.round[lr,f32,fedavg,masked]"] = 1
+    elif drive == "buffered":
+        from fedml_tpu.algorithms.buffered import build_client_step_fn
+        step = build_client_step_fn(trainer, cfg)
+        result = jax.eval_shape(step, gv, x, y, counts, rng)
+        programs["buffered.client_step[lr,f32]"] = 1
+        k = 5
+        row = lambda l: jax.ShapeDtypeStruct(  # noqa: E731
+            (k,) + l.shape[1:], l.dtype)
+        i32 = lambda s=(): jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+        buf = {"vars": jax.tree.map(row, result.variables),
+               "steps": i32((k,)),
+               "weights": jax.ShapeDtypeStruct((k,), jnp.float32),
+               "metrics": {name: row(v)
+                           for name, v in result.metrics.items()},
+               "birth": i32((k,)), "fill": i32()}
+        jax.eval_shape(build_buffer_admit(), buf, result.variables,
+                       result.num_steps, result.metrics, counts,
+                       i32(), i32())
+        programs["buffered.admit[lr,f32]"] = 1
+        jax.eval_shape(build_buffer_commit(agg, make_staleness_discount(0.5)),
+                       gv, agg_state, buf, i32(), rng)
+        programs["buffered.commit[lr,f32,fedavg]"] = 1
+    elif drive == "tensor":
+        from jax.sharding import Mesh
+
+        from fedml_tpu.parallel.tensor import (TensorSharding,
+                                               build_tensor_round_fn)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("clients", "tensor"))
+        round_fn = build_tensor_round_fn(
+            trainer, cfg, agg, TensorSharding.for_model(mesh, "lr"),
+            donate_state=True)
+        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
+        programs["tensor.round[lr,f32,fedavg,2x4]"] = 1
+    elif drive == "sharded":
+        from jax.sharding import Mesh
+
+        from fedml_tpu.parallel.sharded import build_sharded_round_fn
+        mesh = Mesh(np.array(jax.devices()[:8]), ("clients",))
+        round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
+        c = 8
+        jax.eval_shape(round_fn, gv, agg_state,
+                       jax.ShapeDtypeStruct((c, 4) + shape[1:], in_dtype),
+                       jax.ShapeDtypeStruct((c, 4), jnp.int32),
+                       jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+        programs["sharded.round[lr,f32,fedavg,8]"] = 1
+    elif drive == "hierarchical":
+        from jax.sharding import Mesh
+
+        from fedml_tpu.parallel.hierarchical import (
+            build_sharded_hierarchical_round_fn)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("groups", "clients"))
+        round_fn = build_sharded_hierarchical_round_fn(
+            trainer, cfg, mesh, group_comm_round=2)
+        g, c, n = 2, 4, 4
+        jax.eval_shape(round_fn, gv,
+                       jax.ShapeDtypeStruct((g, c, n) + shape[1:], in_dtype),
+                       jax.ShapeDtypeStruct((g, c, n), jnp.int32),
+                       jax.ShapeDtypeStruct((g, c), jnp.int32), rng)
+        # the hierarchical drive has its own runner (no FedAvgAPI evals)
+        return {"hier.round[lr,f32,2x4]": 1}
+    elif drive == "silo":
+        # silo grouping needs convs to group — mirror the jaxpr target
+        programs["silo.round[resnet20,bf16,fedavg]"] = 1
+        jaxpr = round_jaxpr("resnet20", "bfloat16", "fedavg",
+                            silo_threshold=32)
+        del jaxpr
+
+    programs.update(_drive_eval_programs(trainer, shape, in_dtype, gv, rng))
+    return dict(sorted(programs.items()))
+
+
 def run_all(repo_root: str, include_models: bool = True,
             include_ast: bool = True) -> Report:
     """The full lint pass the CLI and tests/test_lint.py run."""

@@ -124,7 +124,8 @@ class MiniBroker:
             # serialize writers per socket: a multi-send PUBLISH fan-out from
             # another connection's thread must not interleave with this
             # connection's own SUBACK/PINGRESP bytes
-            lock = self._send_locks.get(sock)
+            with self._lock:
+                lock = self._send_locks.get(sock)
             if lock is None:
                 raise OSError("peer gone")
             with lock:
@@ -256,8 +257,10 @@ class MqttClient:
             telemetry.emit("mqtt_reconnect", client_id=self._client_id,
                            ok=False, attempts=attempts[0])
             return False
+        with self._send_lock:
+            n_topics = len(self._cbs)
         log.info("mqtt %s: reconnected and resubscribed %d topic(s)",
-                 self._client_id, len(self._cbs))
+                 self._client_id, n_topics)
         telemetry.emit("mqtt_reconnect", client_id=self._client_id,
                        ok=True, attempts=attempts[0])
         return True
@@ -265,7 +268,12 @@ class MqttClient:
     def _loop(self):
         while not self._stop.is_set():
             try:
-                head, body = _read_packet(self._sock)
+                # snapshot the socket ref under the lock (reconnect rebinds
+                # it there) but read packets with the lock RELEASED — a
+                # blocking read under the send lock would starve publishers
+                with self._send_lock:
+                    sock = self._sock
+                head, body = _read_packet(sock)
             except (ConnectionError, OSError):
                 if self._stop.is_set() or not self._reconnect:
                     return
@@ -276,7 +284,8 @@ class MqttClient:
             if ptype == PUBLISH:
                 tlen = struct.unpack(">H", body[:2])[0]
                 topic = body[2:2 + tlen].decode()
-                cb = self._cbs.get(topic)
+                with self._send_lock:
+                    cb = self._cbs.get(topic)
                 if cb is not None:
                     try:
                         cb(topic, body[2 + tlen:])
@@ -290,7 +299,8 @@ class MqttClient:
                                       "for topic %s", self._client_id, topic)
             elif ptype == SUBACK & 0xF0:
                 pid = struct.unpack(">H", body[:2])[0]
-                ev = self._pending_subacks.pop(pid, None)
+                with self._send_lock:
+                    ev = self._pending_subacks.pop(pid, None)
                 if ev is not None:
                     ev.set()
 
@@ -306,15 +316,16 @@ class MqttClient:
 
     def subscribe(self, topic: str, callback: Callable[[str, bytes], None],
                   timeout: float = 10.0):
-        self._cbs[topic] = callback
         ev = threading.Event()
         with self._send_lock:
+            self._cbs[topic] = callback
             self._pid = (self._pid % 0xFFFF) + 1
             pid = self._pid
             self._pending_subacks[pid] = ev
             self._sock.sendall(_subscribe_packet(pid, topic))
         if not ev.wait(timeout):
-            self._pending_subacks.pop(pid, None)
+            with self._send_lock:
+                self._pending_subacks.pop(pid, None)
             raise TimeoutError(f"no SUBACK for {topic!r}")
 
     def publish(self, topic: str, payload: bytes):
@@ -324,8 +335,9 @@ class MqttClient:
     def disconnect(self):
         self._stop.set()
         try:
-            self._sock.sendall(bytes([DISCONNECT, 0]))
-            self._sock.close()
+            with self._send_lock:
+                self._sock.sendall(bytes([DISCONNECT, 0]))
+                self._sock.close()
         except OSError:
             pass
 

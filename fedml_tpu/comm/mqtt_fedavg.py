@@ -111,8 +111,10 @@ class MqttFedAvgServerManager:
             self._handle_model(msg)
 
     def send_init_msg(self):
+        with self._lock:
+            ridx = self.round_idx
         idx = _client_sampling(
-            self.round_idx, self.cfg.client_num_in_total, self.worker_num
+            ridx, self.cfg.client_num_in_total, self.worker_num
         )
         with self._lock:
             self._assignment = {w: idx[w - 1]
@@ -124,11 +126,13 @@ class MqttFedAvgServerManager:
 
     def _send_model(self, msg_type: int, worker: int, client_index: int,
                     round_idx: int | None = None):
+        if round_idx is None:
+            with self._lock:
+                round_idx = self.round_idx
         m = Message(msg_type, 0, worker)
         m.add_model_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_variables)
         m.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, str(client_index))
-        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX,
-              str(self.round_idx if round_idx is None else round_idx))
+        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, str(round_idx))
         self.comm.send_message(m)
 
     def _resend_loop(self):
@@ -162,9 +166,13 @@ class MqttFedAvgServerManager:
     def _handle_model(self, msg: Message):
         sender = msg.get_sender_id()
         raw_ridx = msg.get_params().get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-        if raw_ridx is not None and int(raw_ridx) != self.round_idx:
+        # this dispatch thread is the only round_idx WRITER, so the locked
+        # snapshot stays current for the whole handler
+        with self._lock:
+            current_round = self.round_idx
+        if raw_ridx is not None and int(raw_ridx) != current_round:
             log.info("dropping stale round-%s reply from worker %d "
-                     "(current round %d)", raw_ridx, sender, self.round_idx)
+                     "(current round %d)", raw_ridx, sender, current_round)
             return
         variables = Message.decode_model_params(
             msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS), self.global_variables
@@ -190,21 +198,25 @@ class MqttFedAvgServerManager:
             ).astype(np.asarray(leaves[0]).dtype),
             *models,
         )
-        record = {"round": self.round_idx}
+        record = {"round": current_round}
         if self._eval is not None:
             m = self._eval(self.global_variables, *self._test)
             total = float(m["test_total"])
             record["test_loss"] = float(m["test_loss"]) / max(total, 1.0)
             record["test_acc"] = float(m["test_correct"]) / max(total, 1.0)
         self.history.append(record)
-        log.info("mqtt round %d done: %s", self.round_idx, record)
+        log.info("mqtt round %d done: %s", current_round, record)
 
-        self.round_idx += 1
-        if self.round_idx == self.cfg.comm_round:
+        # advance under the lock: the resend loop snapshots round_idx there,
+        # and an unlocked increment could let it stamp a half-advanced round
+        with self._lock:
+            self.round_idx += 1
+            current_round = self.round_idx
+        if current_round == self.cfg.comm_round:
             self.done.set()
             return
         idx = _client_sampling(
-            self.round_idx, self.cfg.client_num_in_total, self.worker_num
+            current_round, self.cfg.client_num_in_total, self.worker_num
         )
         with self._lock:
             self._assignment = {w: idx[w - 1]

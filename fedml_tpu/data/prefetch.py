@@ -92,7 +92,10 @@ class CohortPrefetcher:
             # the append is atomic under the GIL; single worker => ordered
             self.staged_rounds.append(round_idx)
             staged = self._stage_fn(round_idx)
-            self._staged_at[round_idx] = time.monotonic()
+            # stager thread vs invalidate()'s clear() on the main thread —
+            # the timestamp write must not resurrect an invalidated round
+            with self._lock:
+                self._staged_at[round_idx] = time.monotonic()
             return staged
 
         return self._pool.submit(job)
@@ -122,7 +125,8 @@ class CohortPrefetcher:
         # pipeline-occupancy gauge: how deep the pipeline was when this
         # round was consumed and how long its cohort sat staged-ahead
         # (0 on a miss — it was staged on demand just now)
-        done_at = self._staged_at.pop(round_idx, None)
+        with self._lock:
+            done_at = self._staged_at.pop(round_idx, None)
         ahead_s = max(0.0, time.monotonic() - done_at) if done_at else 0.0
         telemetry.gauge("prefetch_occupancy", round=round_idx,
                         inflight=depth_in_flight, ahead_s=round(ahead_s, 6),

@@ -122,17 +122,18 @@ class StreamingPackedClients:
 
     @property
     def sample_shape(self) -> tuple:
-        if self._sample_shape is None:
-            with self._lock:
-                if self._sample_shape is None:
-                    for k, files in enumerate(self._files):
-                        if files:
-                            self._sample_shape = tuple(
-                                self._decode(files[0]).shape)
-                            break
-                    else:
-                        raise ValueError("no files in any client")
-        return self._sample_shape
+        # RLock makes the unconditional bracket cheap; the old
+        # double-checked-locking fast path read the attr unguarded
+        with self._lock:
+            if self._sample_shape is None:
+                for k, files in enumerate(self._files):
+                    if files:
+                        self._sample_shape = tuple(
+                            self._decode(files[0]).shape)
+                        break
+                else:
+                    raise ValueError("no files in any client")
+            return self._sample_shape
 
     def select(self, client_indices):
         """Gather a round's client rows — decodes at most the sampled
@@ -160,17 +161,21 @@ class StreamingPackedClients:
                         count=stats["hit"])
         telemetry.gauge("store_decode_miss", store="streaming",
                         count=stats["miss"])
+        with self._lock:
+            resident = self._resident_bytes
         telemetry.gauge("store_resident_bytes", store="streaming",
-                        bytes=self._resident_bytes)
+                        bytes=resident)
         return x, self.y[idx], self.counts[idx]
 
     # ---- introspection (tests / ops) -------------------------------------
     @property
     def resident_bytes(self) -> int:
-        return self._resident_bytes
+        with self._lock:
+            return self._resident_bytes
 
     def resident_clients(self) -> list[int]:
-        return list(self._cache)
+        with self._lock:
+            return list(self._cache)
 
     # ---- internals --------------------------------------------------------
     def _client_row(self, k: int, pin: set | None = None,

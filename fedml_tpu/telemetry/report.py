@@ -110,6 +110,25 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     for e in events:
         event_counts[e["kind"]] = event_counts.get(e["kind"], 0) + 1
 
+    # XLA compile accounting from the forwarded jax.monitoring events
+    # (utils/cache.py): every compilation fires one
+    # /jax/compilation_cache/compile_requests_use_cache, then exactly one
+    # of cache_hits / cache_misses. run_compile_gate checks `requests`
+    # against the drive's COMPILE_BUDGET.json max_compiles ceiling.
+    compile_events = [e for e in events if e.get("kind") == "compile_cache"]
+    compile_counts = None
+    if compile_events:
+        def _tail(e):
+            return str(e.get("name", "")).rsplit("/", 1)[-1]
+        compile_counts = {
+            "requests": sum(1 for e in compile_events
+                            if _tail(e) == "compile_requests_use_cache"),
+            "cache_hits": sum(1 for e in compile_events
+                              if _tail(e) == "cache_hits"),
+            "cache_misses": sum(1 for e in compile_events
+                                if _tail(e) == "cache_misses"),
+        }
+
     report = {
         "metric": "fedavg_drive_rounds_per_sec",
         "value": round(rps, 4),
@@ -121,6 +140,8 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "phases": {name: _pcts(durs) for name, durs in sorted(by_name.items())},
         "events": dict(sorted(event_counts.items())),
     }
+    if compile_counts is not None:
+        report["compile"] = compile_counts
     for k in ("platform", "cpu_cores", "cpu_capped", *_WORKLOAD_KEYS):
         if k in meta:
             report[k] = meta[k]
@@ -135,7 +156,11 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 # BENCH_SHARD_* record per-device param bytes on a forced 8-virtual-device
 # mesh, BENCH_BUFF_* record committed-updates/s under a synthetic straggler
 # barrier. All would poison the rounds/s comparison.
-_GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_", "BENCH_BUFF_")
+_GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_", "BENCH_BUFF_",
+                       # budget pin files are not benches at all; the glob
+                       # below can't match them today, but skip by NAME so a
+                       # future BENCH_-style rename can't poison the gate
+                       "COMPILE_BUDGET", "COMMS_BUDGET")
 
 
 def newest_bench(root: str) -> Optional[Tuple[str, Dict[str, Any]]]:
@@ -216,3 +241,46 @@ def run_gate(report: Dict[str, Any], bench_path: str,
         f"  buffer donation, or compile-cache misses (TRACE.jsonl event\n"
         f"  ledger, kind=compile_cache), then rerun tools/bench_pipeline.py\n"
         f"  to re-baseline deliberately if the slowdown is intended")
+
+
+def run_compile_gate(report: Dict[str, Any], budgets: Dict[str, Any],
+                     drive: str) -> Tuple[bool, bool, str]:
+    """(ok, skipped, message): the compile-count half of the budget gate.
+
+    `report` is a fold()ed trace; `budgets` is the parsed
+    COMPILE_BUDGET.json; `drive` names the budget entry whose
+    `max_compiles` ceiling the traced run must not exceed. The ceiling is
+    measured ground truth for the FULL 10-round config (drive programs plus
+    every op-by-op utility dispatch), so shorter runs of the same config
+    always fit under it — any excess means a program compiled that the
+    budget never saw: a retrace."""
+    comp = report.get("compile")
+    if not comp:
+        return True, True, (
+            "compile gate: SKIP — trace has no compile_cache events "
+            "(was the run traced with enable_compile_cache() active?)")
+    entry = budgets.get(drive, {})
+    ceiling = entry.get("max_compiles")
+    if ceiling is None:
+        return True, True, (
+            f"compile gate: SKIP — no max_compiles ceiling for drive "
+            f"{drive!r} in COMPILE_BUDGET.json; run `python -m "
+            f"fedml_tpu.analysis --compile --update-budgets` (with "
+            f"measurement) to pin one")
+    measured = comp["requests"]
+    detail = (f"  budget    COMPILE_BUDGET.json[{drive}]  "
+              f"max_compiles={ceiling}\n"
+              f"  measured  TRACE  {measured} compile request(s) "
+              f"({comp['cache_misses']} miss(es), "
+              f"{comp['cache_hits']} hit(s))")
+    if measured <= ceiling:
+        return True, False, f"compile gate: PASS\n{detail}"
+    return False, False, (
+        f"compile gate: FAIL\n{detail}\n"
+        f"  the run compiled {measured - ceiling} more program(s) than the "
+        f"budgeted config ever does: a call site is retracing.\n"
+        f"  hunt it with the retrace-risk lint (`python -m "
+        f"fedml_tpu.analysis --compile`) — look for Python scalars, "
+        f"weak-typed literals,\n  or shape-varying operands feeding a "
+        f"jitted call — then either fix the call site or re-measure "
+        f"deliberately with --update-budgets")

@@ -1,0 +1,181 @@
+"""Compile budgets: COMPILE_BUDGET.json pins the exact compiled-program
+count per drive config, check_budgets trips on any drift with a readable
+diff, --update-budgets round-trips the committed file byte-stable, and
+run_compile_gate ties a traced run's compile count to the measured ceiling.
+
+The subprocess within-budget runs (10-round CLI drives) are slow-marked;
+the fast suite covers the same gate logic on synthetic fold() reports plus
+the real budget file's invariants."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from fedml_tpu.analysis.compile_engine import (
+    BUDGET_FILE,
+    RUNTIME_DRIVE_CLI,
+    check_budgets,
+    load_budgets,
+    make_budgets,
+    run_compile,
+)
+from fedml_tpu.telemetry.report import fold, load_trace, run_compile_gate
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ev(name):
+    return {"type": "event", "kind": "compile_cache",
+            "name": f"/jax/compilation_cache/{name}"}
+
+
+def _report_with_compiles(requests, hits=0):
+    records = [_ev("compile_requests_use_cache") for _ in range(requests)]
+    records += [_ev("cache_hits") for _ in range(hits)]
+    records += [_ev("cache_misses") for _ in range(requests - hits)]
+    return fold(records)
+
+
+# ------------------------------------------------------- budget file shape
+
+def test_budget_file_pins_every_runtime_drive():
+    budgets = load_budgets(ROOT)
+    for drive, cli in RUNTIME_DRIVE_CLI.items():
+        entry = budgets[drive]
+        assert entry["cli"] == cli
+        assert entry["max_compiles"] >= entry["static_total"] - 1, (
+            f"{drive}: runtime ceiling below the static program count "
+            f"minus the eval geometry the short run may skip")
+        assert entry["static_total"] == sum(entry["programs"].values())
+
+
+def test_budget_file_covers_every_drive_config():
+    from fedml_tpu.analysis.targets import DRIVE_CONFIGS
+    budgets = load_budgets(ROOT)
+    assert sorted(budgets) == sorted(DRIVE_CONFIGS)
+    for entry in budgets.values():
+        assert entry["static_total"] == sum(entry["programs"].values())
+
+
+def test_repo_enumeration_matches_pins():
+    # the static half of the gate, in-process: every drive's reachable
+    # program set equals its pin exactly (two-way)
+    from fedml_tpu.analysis.targets import (DRIVE_CONFIGS,
+                                            enumerate_drive_programs)
+    budgets = load_budgets(ROOT)
+    measured = {d: enumerate_drive_programs(d) for d in DRIVE_CONFIGS}
+    findings = check_budgets(measured, budgets)
+    assert not findings, "\n".join(f.message for f in findings)
+
+
+# ------------------------------------------------ check_budgets diff teeth
+
+def test_synthetic_retrace_trips_budget_with_readable_diff():
+    # a call site that retraces shows up as an extra signature on an
+    # already-pinned program — the finding must carry the +N diff
+    budgets = load_budgets(ROOT)
+    measured = {"eager": dict(budgets["eager"]["programs"])}
+    measured["eager"]["engine.round[lr,f32,fedavg]"] += 2
+    findings = check_budgets(measured, budgets)
+    assert len(findings) == 1
+    assert findings[0].rule == "compile-budget"
+    assert "(+2)" in findings[0].message
+    assert "engine.round[lr,f32,fedavg]" in findings[0].message
+    assert "--update-budgets" in findings[0].message
+
+
+def test_unbudgeted_program_and_stale_pin_both_trip():
+    budgets = load_budgets(ROOT)
+    measured = {"eager": dict(budgets["eager"]["programs"])}
+    measured["eager"]["engine.round[lr,f32,fedavg,surprise]"] = 1
+    del measured["eager"]["engine.eval[lr,f32]"]
+    msgs = [f.message for f in check_budgets(measured, budgets)]
+    assert any("not budgeted" in m for m in msgs)
+    assert any("stale budget pin" in m for m in msgs)
+
+
+def test_missing_drive_entry_is_a_finding():
+    findings = check_budgets({"warp": {"warp.round": 1}}, load_budgets(ROOT))
+    assert findings and "no COMPILE_BUDGET.json entry" in findings[0].message
+
+
+# ------------------------------------------------- update round-trip
+
+def test_update_budgets_round_trips_byte_stable(tmp_path):
+    # the committed file is canonical: re-deriving the runtime drives'
+    # entries over it (measure=False keeps the pinned ceilings) must
+    # reproduce it byte-for-byte, twice
+    committed = open(os.path.join(ROOT, BUDGET_FILE), "rb").read()
+    shutil.copy(os.path.join(ROOT, BUDGET_FILE), tmp_path / BUDGET_FILE)
+    for _ in range(2):
+        report, _ = run_compile(str(tmp_path), fast=True,
+                                update_budgets=True, measure=False)
+        assert report.ok, "\n" + report.summary()
+        assert (tmp_path / BUDGET_FILE).read_bytes() == committed
+
+
+# ------------------------------------------------------- runtime gate
+
+def test_compile_gate_passes_at_ceiling():
+    budgets = load_budgets(ROOT)
+    ceiling = budgets["pipelined"]["max_compiles"]
+    ok, skipped, msg = run_compile_gate(
+        _report_with_compiles(ceiling), budgets, "pipelined")
+    assert ok and not skipped
+    assert "PASS" in msg
+
+
+def test_compile_gate_trips_on_extra_compile():
+    # the deliberate extra-compile self-test: one more request than the
+    # measured ceiling means some call site retraced
+    budgets = load_budgets(ROOT)
+    ceiling = budgets["pipelined"]["max_compiles"]
+    ok, skipped, msg = run_compile_gate(
+        _report_with_compiles(ceiling + 1), budgets, "pipelined")
+    assert not ok and not skipped
+    assert "FAIL" in msg and "retrac" in msg
+    assert "1 more program(s)" in msg
+
+
+def test_compile_gate_skips_untraced_run():
+    ok, skipped, _ = run_compile_gate(fold([]), load_budgets(ROOT),
+                                      "pipelined")
+    assert ok and skipped
+
+
+def test_compile_gate_skips_drive_without_ceiling():
+    # hierarchical has no CLI drive, hence no measured max_compiles
+    ok, skipped, msg = run_compile_gate(
+        _report_with_compiles(3), load_budgets(ROOT), "hierarchical")
+    assert ok and skipped
+    assert "max_compiles" in msg
+
+
+# ------------------------------------- slow: real 10-round drives fit
+
+@pytest.mark.slow
+@pytest.mark.parametrize("drive", ["eager", "pipelined", "buffered"])
+def test_traced_drive_run_stays_within_budget(drive, tmp_path):
+    # ground truth: a fresh 10-round CLI run of the budgeted config
+    # compiles zero un-budgeted programs (jit caches are process-global,
+    # so this must be a subprocess)
+    budgets = load_budgets(ROOT)
+    cmd = [sys.executable, "-m", "fedml_tpu.experiments.main_fedavg",
+           "--run_dir", str(tmp_path), "--seed", "0",
+           "--dataset", "mnist", "--data_dir", "./data",
+           "--model", "lr", "--client_num_in_total", "8",
+           "--client_num_per_round", "8", "--epochs", "1",
+           "--batch_size", "4", "--frequency_of_the_test", "5",
+           ] + budgets[drive]["cli"].split()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    subprocess.run(cmd, cwd=ROOT, env=env, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    report = fold(load_trace(str(tmp_path / "TRACE.jsonl")))
+    ok, skipped, msg = run_compile_gate(report, budgets, drive)
+    assert ok and not skipped, msg

@@ -473,6 +473,250 @@ def test_full_store_suppression_works():
     assert not _store_findings(src)
 
 
+# ----------------------------------------- compile layer: retrace-risk (AST)
+
+def _retrace_findings(src):
+    return [f for f in _findings(src) if f.rule == "retrace-risk"]
+
+
+def test_retrace_risk_fires_on_scalar_literal_into_jitted_call():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x):\n"
+        "    return f(x, 0.1)\n")
+    findings = _retrace_findings(src)
+    assert findings and "weak-typed" in findings[0].message
+
+
+def test_retrace_risk_fires_on_float_cast_argument():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x, s):\n"
+        "    return f(x, float(s))\n")
+    assert _retrace_findings(src)
+
+
+def test_retrace_risk_fires_on_shape_varying_slice():
+    # x[:n] changes shape per call -> one compile per distinct n
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x, n):\n"
+        "    return f(x[:n])\n")
+    findings = _retrace_findings(src)
+    assert findings and "shape-varying" in findings[0].message
+
+
+def test_retrace_risk_clean_on_strongly_typed_scalar():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(g)\n"
+        "def run(x, s):\n"
+        "    return f(x, jnp.float32(s))\n")
+    assert not _retrace_findings(src)
+
+
+def test_retrace_risk_suppression_works():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x):\n"
+        "    # graft-lint: disable=retrace-risk -- two geometries by construction\n"
+        "    return f(x, 0.1)\n")
+    assert not _retrace_findings(src)
+
+
+# ------------------------------------- compile layer: use-after-donate (AST)
+
+def _donate_findings(src):
+    return [f for f in _findings(src) if f.rule == "use-after-donate"]
+
+
+def test_use_after_donate_fires_on_read_of_donated_jit_arg():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g, donate_argnums=(0,))\n"
+        "def run(x):\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+    findings = _donate_findings(src)
+    assert findings and "donated" in findings[0].message
+
+
+def test_use_after_donate_fires_through_build_factory():
+    # build_round_fn(donate_data=True) donates (x, y, counts) — argnums 2-4
+    src = (
+        "def run(trainer, cfg, agg, gv, st, x, y, counts, rng):\n"
+        "    step = build_round_fn(trainer, cfg, agg, donate_data=True)\n"
+        "    gv, st, m = step(gv, st, x, y, counts, rng)\n"
+        "    return x.sum()\n")
+    assert _donate_findings(src)
+
+
+def test_use_after_donate_rebinding_is_blessed():
+    # x = f(x) is the canonical donation idiom: the dead name is re-bound
+    src = (
+        "import jax\n"
+        "f = jax.jit(g, donate_argnums=(0,))\n"
+        "def run(x):\n"
+        "    x = f(x)\n"
+        "    return x\n")
+    assert not _donate_findings(src)
+
+
+def test_use_after_donate_no_donation_no_finding():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x):\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+    assert not _donate_findings(src)
+
+
+def test_use_after_donate_suppression_works():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g, donate_argnums=(0,))\n"
+        "def run(x):\n"
+        "    y = f(x)\n"
+        "    # graft-lint: disable=use-after-donate -- donation elided on CPU fixture\n"
+        "    return x + y\n")
+    assert not _donate_findings(src)
+
+
+# -------------------------------------- compile layer: rng-key-reuse (AST)
+
+def _rng_findings(src):
+    return [f for f in _findings(src) if f.rule == "rng-key-reuse"]
+
+
+def test_rng_key_reuse_fires_on_second_consumption():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x, y):\n"
+        "    rng = jax.random.PRNGKey(0)\n"
+        "    a = f(x, rng)\n"
+        "    b = f(y, rng)\n"
+        "    return a + b\n")
+    findings = _rng_findings(src)
+    assert findings and "second" in findings[0].message
+
+
+def test_rng_key_reuse_fires_on_loop_replay():
+    # same key every iteration -> identical "randomness" each round
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(xs):\n"
+        "    rng = jax.random.PRNGKey(0)\n"
+        "    for x in xs:\n"
+        "        out = f(x, rng)\n")
+    findings = _rng_findings(src)
+    assert findings and "loop" in findings[0].message
+
+
+def test_rng_key_reuse_fold_in_derivation_is_blessed():
+    # the repo idiom: derive a fresh per-iteration key inside the call
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(xs):\n"
+        "    rng = jax.random.PRNGKey(0)\n"
+        "    for i, x in enumerate(xs):\n"
+        "        out = f(x, jax.random.fold_in(rng, i))\n")
+    assert not _rng_findings(src)
+
+
+def test_rng_key_reuse_suppression_works():
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x, y):\n"
+        "    rng = jax.random.PRNGKey(0)\n"
+        "    a = f(x, rng)\n"
+        "    # graft-lint: disable=rng-key-reuse -- twins must see the identical key\n"
+        "    b = f(y, rng)\n"
+        "    return a + b\n")
+    assert not _rng_findings(src)
+
+
+# ------------------------------------ compile layer: lock-discipline (AST)
+
+def _lock_findings(src):
+    return [f for f in _findings(src) if f.rule == "lock-discipline"]
+
+
+def test_lock_discipline_fires_on_unguarded_read_of_guarded_attr():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._staged = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._staged[k] = v\n"
+        "    def peek(self, k):\n"
+        "        return self._staged.get(k)\n")
+    findings = _lock_findings(src)
+    assert findings and "_staged" in findings[0].message
+
+
+def test_lock_discipline_clean_when_every_touch_is_bracketed():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._staged = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._staged[k] = v\n"
+        "    def peek(self, k):\n"
+        "        with self._lock:\n"
+        "            return self._staged.get(k)\n")
+    assert not _lock_findings(src)
+
+
+def test_lock_discipline_lock_held_caller_propagates():
+    # _peek_locked is only ever called under the lock -> its unguarded
+    # touch of self._staged is fine (call-graph propagation)
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._staged = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._staged[k] = v\n"
+        "            self._peek_locked(k)\n"
+        "    def _peek_locked(self, k):\n"
+        "        return self._staged.get(k)\n")
+    assert not _lock_findings(src)
+
+
+def test_lock_discipline_suppression_works():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._staged = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._staged[k] = v\n"
+        "    def peek(self, k):\n"
+        "        # graft-lint: disable=lock-discipline -- read-only probe, GIL-atomic\n"
+        "        return self._staged.get(k)\n")
+    assert not _lock_findings(src)
+
+
 # ------------------------------------------------------------ partition rules
 
 def test_partition_coverage_fires_on_unmatched_leaf():

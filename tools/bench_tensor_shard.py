@@ -114,6 +114,7 @@ def bench_model(model_name: str):
         }
         # warm compile outside the timed window; state flows round-to-round
         # exactly as the drive loop runs it (donated shards)
+        # graft-lint: disable=rng-key-reuse -- timing bench: every arm (and every timed step) deliberately replays the same key so the rounds are identical work
         gvp, stp, _ = round_fn(gvp, stp, x, y, counts, rng)
         jax.block_until_ready(gvp)
         t0 = time.perf_counter()
