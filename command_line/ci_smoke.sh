@@ -162,11 +162,13 @@ echo "== graft-trace smoke (depth-2 chaos drive: --trace_summary + span coverage
 # TRACE.jsonl lands next to the run files and must cover >=95% of round
 # wall-clock with phase spans and carry the chaos/commit event ledger
 rm -rf /tmp/ci_smoke_trace_ckpt   # a stale ckpt would resume past the rounds
+rm -rf /tmp/ci_smoke_ledger       # open_or_create ACCUMULATES across runs
 python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
   --client_num_in_total 8 --client_num_per_round 8 --comm_round 2 \
   --epochs 1 --batch_size 4 --pipeline_depth 2 \
   --chaos 1 --chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 --guard 1 \
   --ckpt_dir /tmp/ci_smoke_trace_ckpt \
+  --client_ledger_dir /tmp/ci_smoke_ledger \
   --trace_summary 1 | tee /tmp/ci_smoke_trace_stdout.txt
 grep -Eq '^phase +count +total_s +p50_ms +p95_ms' /tmp/ci_smoke_trace_stdout.txt
 grep -Eq '^dispatch ' /tmp/ci_smoke_trace_stdout.txt
@@ -192,6 +194,38 @@ ok, skipped, msg = run_compile_gate(report, budgets, "pipelined")
 print(msg)
 assert ok and not skipped, msg
 EOF
+
+echo "== client-health ledger smoke (graft-ledger fleet view + gate)"
+# the depth-2 chaos drive above also wrote the per-client ledger; the fleet
+# report must gate PASS — full coverage (every client sampled both rounds),
+# and the ledger's dispatch-time quarantine accounting must agree with the
+# trace's commit-time round_committed counters (two independent paths)
+python tools/client_report.py /tmp/ci_smoke_ledger \
+  --trace "$RUN_DIR/TRACE.jsonl" --gate --coverage_floor 0.9 \
+  | tee /tmp/ci_smoke_ledger_report.txt
+python - <<'EOF'
+import json
+line = [l for l in open("/tmp/ci_smoke_ledger_report.txt")
+        if l.startswith("{")][-1]
+r = json.loads(line)
+assert r["num_clients"] == 8 and r["coverage"] == 1.0, r
+assert r["quarantine_total"] >= 1, r          # nan chaos must quarantine
+assert r["quarantine_total"] == r["trace_quarantined_total"], r
+assert r["drop_total"] >= 1, r                # drop chaos must drop
+print(f"OK ledger report: quarantined={r['quarantine_total']} "
+      f"dropped={r['drop_total']} gini={r['participation_gini']}")
+EOF
+echo "== ledger gate self-test: a zero flagged-ceiling must trip (exit 1)"
+# recidivist_min=1 guarantees a non-empty flagged set (the chaos smoke
+# asserted quarantined_count >= 1), so ceiling 0 must fail the gate
+if python tools/client_report.py /tmp/ci_smoke_ledger --gate \
+     --recidivist_min 1 --flagged_ceiling 0 >/tmp/ci_smoke_ledger_trip.txt 2>&1; then
+  echo "client-health gate FAILED TO TRIP on a zero flagged ceiling:"
+  cat /tmp/ci_smoke_ledger_trip.txt
+  exit 1
+fi
+grep -q 'client-health gate: FAIL' /tmp/ci_smoke_ledger_trip.txt
+echo "OK client-health gate trips on zero flagged ceiling"
 
 echo "== buffered straggler smoke (FedBuff drive: no round barrier, depth-2)"
 # seeded straggler plan: half the cohort arrives 1-2 dispatch rounds late,
@@ -289,6 +323,24 @@ assert p["clients"] == 50000 and p["rounds_per_sec"] > 0, p
 assert not p["rss_budget_exceeded"], p
 assert p["store_physical_mb"] < p["store_logical_mb"] / 10, p  # sparse store
 print(f"OK scale point: rss={p['peak_rss_mb']}MB rps={p['rounds_per_sec']}")
+EOF
+
+echo "== 1M-client ledger scale smoke (mmap columns, RSS budget gate)"
+# the same RSS budget must hold with a FULL-federation client-health ledger
+# attached: per-round scatter writes touch O(cohort) mmap pages, so a
+# million-client ledger costs pages, not gigabytes of resident columns
+python tools/bench_scale.py --point --clients 1000000 --rounds 3 \
+  --rss_budget_mb 400 --ledger --fast_sampling | tee /tmp/ci_scale_ledger.txt
+python - <<'EOF'
+import json
+line = [l for l in open("/tmp/ci_scale_ledger.txt") if l.startswith("{")][-1]
+p = json.loads(line)
+assert not p["rss_budget_exceeded"], p
+led = p["ledger"]
+assert led["participating"] == 4 * 64, led  # warm + 3 rounds x CPR=64
+assert led["physical_mb"] < led["logical_mb"], led  # sparse columns
+print(f"OK 1M-client ledger point: rss={p['peak_rss_mb']}MB "
+      f"ledger_physical={led['physical_mb']}MB")
 EOF
 
 echo "== scale RSS budget self-test: a 1MB budget must trip (exit 1)"

@@ -55,17 +55,27 @@ from fedml_tpu.telemetry.records import RoundRecordLog
 log = logging.getLogger(__name__)
 
 
-def build_client_step_fn(trainer, cfg, donate_data: bool = False):
+def build_client_step_fn(trainer, cfg, donate_data: bool = False,
+                         collect_stats: bool = False):
     """Jitted cohort step WITHOUT aggregation: vmap(local_update) over the
     staged cohort, same per-client rng stream as the synchronous round
     (crngs = split(round_rng, C)) — so a buffered run and a synchronous run
     at the same round rng train bit-identical client updates. The stacked
-    LocalResult stays device-resident until every row has been admitted."""
+    LocalResult stays device-resident until every row has been admitted.
+
+    `collect_stats=True` returns `(result, cohort_stats_rows)` from the same
+    program — the buffered drive's feed into the client ledger (admit/commit
+    programs stay byte-identical; stats are dispatch-time observations)."""
     batched = _vmapped_update(trainer, cfg)
 
     def client_step(global_variables, x, y, counts, rng):
         crngs = jax.random.split(rng, x.shape[0])
-        return batched(global_variables, x, y, counts, crngs)
+        result = batched(global_variables, x, y, counts, crngs)
+        if collect_stats:
+            from fedml_tpu.algorithms.engine import cohort_stats
+
+            return result, cohort_stats(global_variables, result)
+        return result
 
     telemetry.emit("round_fn_built", program="buffered.client_step",
                    donate=donate_data)
@@ -115,6 +125,8 @@ class _HostState:
         self.arrivals: Dict[int, List[Tuple[int, int]]] = {}
         self.fill = 0            # mirrors buf["fill"] (admits are host-driven)
         self.births: List[int] = []  # birth tag of each filled buffer row
+        # global client id of each filled row (ledger staleness attribution)
+        self.row_clients: List[int] = []
         self.commits = 0
         self.committed_updates = 0
 
@@ -123,22 +135,24 @@ class _HostState:
             {b: dict(d) for b, d in self.pending.items()},
             {r: list(v) for r, v in self.arrivals.items()},
             self.fill, list(self.births), self.commits,
-            self.committed_updates,
+            self.committed_updates, list(self.row_clients),
         )
 
     def restore(self, snap):
-        pending, arrivals, fill, births, commits, committed = snap
+        (pending, arrivals, fill, births, commits, committed,
+         row_clients) = snap
         self.pending = {b: dict(d) for b, d in pending.items()}
         self.arrivals = {r: list(v) for r, v in arrivals.items()}
         self.fill = fill
         self.births = list(births)
         self.commits = commits
         self.committed_updates = committed
+        self.row_clients = list(row_clients)
 
 
 def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                    metrics_logger, chaos, guard, tracer,
-                   discount_fn=None) -> None:
+                   discount_fn=None, ledger=None) -> None:
     """The buffered drive loop (`cfg.buffer_size > 0`), called from
     FedAvgAPI.train() under its tracer/checkpoint scaffolding.
 
@@ -160,8 +174,13 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
     donate_buffer = guard is None
     admit_fn = build_buffer_admit(donate_buffer=donate_buffer)
     commit_fn = build_buffer_commit(api.aggregator, discount_fn)
-    client_step = build_client_step_fn(api.trainer, cfg, donate_data=True)
-    records = RoundRecordLog(tracer, api.history, metrics_logger)
+    # stats are always collected (the traced program must not depend on
+    # whether a ledger happens to be attached — ledger on/off bit-identity);
+    # the admit/commit programs are untouched
+    client_step = build_client_step_fn(api.trainer, cfg, donate_data=True,
+                                       collect_stats=True)
+    records = RoundRecordLog(tracer, api.history, metrics_logger,
+                             ledger=ledger)
     prefetcher = None
     if cfg.pipeline_depth > 0:
         prefetcher = CohortPrefetcher(
@@ -178,7 +197,8 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
             rng = jax.random.fold_in(rng, salt)
         return rng
 
-    def do_commit(commit_round: int, rng_round, seq: int, commit_metrics):
+    def do_commit(commit_round: int, rng_round, seq: int, commit_metrics,
+                  ledger_blocks):
         """One buffer commit; returns the commit's device metric dict."""
         rng = rng_round if seq == 0 else jax.random.fold_in(rng_round, seq)
         with tracer.span("commit", commit_round):
@@ -192,16 +212,23 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                      staleness_p50=p50, staleness_max=int(smax))
         telemetry.gauge("staleness", round=commit_round, p50=p50,
                         max=int(smax))
+        # per-client staleness attribution for the ledger (host-derived —
+        # the commit program is unchanged); rides the record's _ledger key
+        ledger_blocks.append({
+            "round": commit_round,
+            "client_idx": np.asarray(host.row_clients, np.int64),
+            "staleness": np.asarray(staleness, np.int32)})
         host.committed_updates += host.fill
         host.commits += 1
         host.fill = 0
         host.births = []
+        host.row_clients = []
         # the commit only read the buffer — reset the fill scalar host-side
         api._buffer = dict(api._buffer, fill=jnp.zeros((), jnp.int32))
         commit_metrics.append(m)
 
     def process_arrivals(now: int, rng_round, commit_metrics,
-                         seq_base: int) -> int:
+                         ledger_blocks, seq_base: int) -> int:
         """Admit round `now`'s due arrivals in (birth, slot) order; commit
         every time the buffer fills. Returns the number of commits made."""
         due = sorted(host.arrivals.pop(now, []))
@@ -214,6 +241,9 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                     src["counts"], np.int32(slot), np.int32(birth))
             host.fill += 1
             host.births.append(birth)
+            # host numpy row (pending stores client_idx as np.asarray at
+            # dispatch), so this index is a host read, not a device fetch
+            host.row_clients.append(src["client_idx"][slot])
             tracer.event("update_admitted", round=now, birth=birth,
                          fill=host.fill)
             src["remaining"] -= 1
@@ -221,7 +251,7 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                 del host.pending[birth]
             if host.fill == k:
                 do_commit(now, rng_round, seq_base + n_commits,
-                          commit_metrics)
+                          commit_metrics, ledger_blocks)
                 n_commits += 1
         return n_commits
 
@@ -247,8 +277,9 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                                 api._buffer, host.snapshot())
                 rng_round = base_rng(round_idx, retries)
                 with tracer.span("dispatch", round_idx):
-                    result = client_step(api.global_variables, staged.x,
-                                         staged.y, staged.counts, rng_round)
+                    result, stats = client_step(
+                        api.global_variables, staged.x, staged.y,
+                        staged.counts, rng_round)
                 if api._buffer is None:
                     api._buffer = init_buffer(result, k)
                 n = len(staged.client_idx)
@@ -266,11 +297,23 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                         "steps": result.num_steps,
                         "metrics": result.metrics,
                         "counts": staged.counts,
+                        # slot -> global client id, read back at admit time
+                        # for the ledger's staleness attribution
+                        "client_idx": np.asarray(staged.client_idx),
                         "remaining": len(surviving),
                     }
+                participated = (
+                    np.asarray(staged.faults.participation, bool)
+                    if staged.faults is not None else np.ones(n, bool))
+                ledger_blocks: list = [{
+                    "round": round_idx,
+                    "client_idx": np.asarray(staged.client_idx),
+                    "participated": participated,
+                    "stats": stats}]
                 commit_metrics: list = []
                 n_commits = process_arrivals(round_idx, rng_round,
-                                             commit_metrics, seq_base=0)
+                                             commit_metrics, ledger_blocks,
+                                             seq_base=0)
                 telemetry.gauge("buffer_fill", round=round_idx,
                                 fill=host.fill, commits=n_commits)
                 train_metrics: dict = {}
@@ -310,7 +353,8 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                 record = {"round": round_idx, "round_time": rspan.elapsed(),
                           "buffer_commits": n_commits,
                           "committed_updates": host.committed_updates,
-                          "buffer_fill": host.fill}
+                          "buffer_fill": host.fill,
+                          "_ledger": ledger_blocks}
                 for key in ("loss_sum", "total", "participated_count",
                             "quarantined_count", "staleness_sum",
                             "staleness_max"):
@@ -343,20 +387,24 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
     # work runs here, so the schedule stays a pure function of the seed.
     drain_round = cfg.comm_round
     commit_metrics = []
+    drain_ledger_blocks: list = []
     drain_commits = 0
     while host.arrivals:
         rng_round = base_rng(drain_round, 0)
         drain_commits += process_arrivals(drain_round, rng_round,
-                                          commit_metrics, seq_base=0)
+                                          commit_metrics,
+                                          drain_ledger_blocks, seq_base=0)
         drain_round += 1
     if host.fill > 0:
-        do_commit(drain_round, base_rng(drain_round, 0), 0, commit_metrics)
+        do_commit(drain_round, base_rng(drain_round, 0), 0, commit_metrics,
+                  drain_ledger_blocks)
         drain_commits += 1
     if drain_commits:
         record = {"round": cfg.comm_round, "round_time": 0.0,
                   "buffer_commits": drain_commits,
                   "committed_updates": host.committed_updates,
-                  "buffer_fill": host.fill}
+                  "buffer_fill": host.fill,
+                  "_ledger": drain_ledger_blocks}
         with tracer.span("metrics_fetch", drain_round):
             for m in jax.device_get(commit_metrics):
                 for key in m:

@@ -313,8 +313,41 @@ def _vmapped_update(trainer, cfg: FedConfig) -> Callable:
     return batched
 
 
+def cohort_stats(global_variables, result: LocalResult) -> dict:
+    """Static-shape per-cohort health stats for the client ledger.
+
+    Four [C]-rows aligned with the cohort axis — per-client update L2-norm
+    (over inexact param leaves), finiteness verdict, and the loss_sum/total
+    pair the EMA-loss derives from. Everything is computed per client with
+    NO cross-client reductions and NO new collectives, so sharded callers
+    can return these rows under the plain clients-axis out-spec. Computed
+    from the RAW client results (pre-quarantine) on purpose: a poisoned
+    update must be visible in the ledger even though aggregation zeroes it.
+    """
+    from fedml_tpu.algorithms.aggregators import client_finite_mask
+
+    total_sq = None
+    for g, p in zip(jax.tree.leaves(global_variables["params"]),
+                    jax.tree.leaves(result.variables["params"])):
+        if not jnp.issubdtype(p.dtype, jnp.inexact):
+            continue
+        d = (p - g[None]).astype(jnp.float32)
+        sq = jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+        total_sq = sq if total_sq is None else total_sq + sq
+    norm = (jnp.sqrt(total_sq) if total_sq is not None
+            else jnp.zeros(result.num_steps.shape[0], jnp.float32))
+    zeros = jnp.zeros_like(norm)
+    return {
+        "update_norm": norm,
+        "finite": client_finite_mask(result.variables),
+        "loss_sum": result.metrics.get("loss_sum", zeros).astype(jnp.float32),
+        "total": result.metrics.get("total", zeros).astype(jnp.float32),
+    }
+
+
 def build_round_fn_from_update(batched_update, aggregator,
-                               donate_data: bool = False) -> Callable:
+                               donate_data: bool = False,
+                               collect_stats: bool = False) -> Callable:
     """Jitted synchronous round over any batched client update (the vmap
     engine below, or the silo-grouped update in algorithms/silo_grouped.py —
     one definition of the rng stream and metrics contract for both).
@@ -352,6 +385,10 @@ def build_round_fn_from_update(batched_update, aggregator,
                  participation=None):
         crngs = jax.random.split(rng, x.shape[0])
         result = batched_update(global_variables, x, y, counts, crngs)
+        # ledger stats come from the RAW results (pre-quarantine) so the
+        # poisoned rows aggregation zeroes below stay visible per-client
+        stats = cohort_stats(global_variables, result) if collect_stats \
+            else None
         weights = counts.astype(jnp.float32)
         if participation is None:
             new_global, new_state = aggregator(
@@ -359,6 +396,8 @@ def build_round_fn_from_update(batched_update, aggregator,
             )
             # per-client metric sums -> federation totals
             metrics = {k: v.sum() for k, v in result.metrics.items()}
+            if collect_stats:
+                return new_global, new_state, metrics, stats
             return new_global, new_state, metrics
         result, weights, alive, quarantined = quarantine_stage(
             result, weights, participation)
@@ -371,6 +410,8 @@ def build_round_fn_from_update(batched_update, aggregator,
         metrics = {k: v.sum() for k, v in result.metrics.items()}
         metrics["participated_count"] = alive.sum().astype(jnp.float32)
         metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        if collect_stats:
+            return new_global, new_state, metrics, stats
         return new_global, new_state, metrics
 
     # ledger breadcrumb for multi-program debugging (async aggregation /
@@ -399,7 +440,8 @@ def build_round_fn_from_update(batched_update, aggregator,
 
 def build_round_fn(trainer, cfg: FedConfig, aggregator,
                    donate_data: bool = False,
-                   param_sharding=None) -> Callable:
+                   param_sharding=None,
+                   collect_stats: bool = False) -> Callable:
     """Jitted synchronous round: vmap(local_update) + aggregate.
 
     `param_sharding` (a parallel.tensor.TensorSharding) switches the round
@@ -407,6 +449,11 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
     tensor-sharded between rounds, the client vmap step runs on gathered
     params, and aggregation psums move 1/tensor_shards of the bytes. The
     cohort axis and participation-mask semantics are unchanged.
+
+    `collect_stats=True` makes the round return a fourth output — the
+    per-cohort `cohort_stats` health rows for the client ledger — from the
+    SAME traced program (extra outputs, not extra programs or sync points).
+    The default traces the exact legacy 3-tuple program.
     """
     if param_sharding is not None:
         from fedml_tpu.parallel.tensor import build_tensor_round_fn
@@ -414,9 +461,10 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
         return build_tensor_round_fn(
             trainer, cfg, aggregator, param_sharding,
             donate_state=bool(cfg.extra.get("donate_params", False)),
-            donate_data=donate_data)
+            donate_data=donate_data, collect_stats=collect_stats)
     return build_round_fn_from_update(_vmapped_update(trainer, cfg),
-                                      aggregator, donate_data=donate_data)
+                                      aggregator, donate_data=donate_data,
+                                      collect_stats=collect_stats)
 
 
 def stage_to_device(x, y, counts, participation=None) -> tuple:

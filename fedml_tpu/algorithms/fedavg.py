@@ -143,10 +143,19 @@ class FedAvgAPI(Checkpointable):
             self.mesh = make_tensor_mesh(config.tensor_shards)
             self._tensor_sharding = TensorSharding.for_model(
                 self.mesh, config.model)
+        # the API's round programs ALWAYS return the ledger's per-cohort
+        # stats rows (collect_stats=True): whether a ledger is attached to
+        # the drive only changes host-side scatter writes, never the traced
+        # program — that is the whole ledger on/off bit-identity argument.
+        # Direct builder callers (bench, analysis enumeration) keep the
+        # legacy 3-tuple default, so COMPILE/COMMS budgets are untouched.
+        self._round_has_stats = True
+        if config.tensor_shards > 0:
             self.round_fn = build_round_fn(
                 model_trainer, config, self.aggregator,
                 donate_data=config.pipeline_depth > 0,
-                param_sharding=self._tensor_sharding)
+                param_sharding=self._tensor_sharding,
+                collect_stats=True)
         elif config.backend == "shard_map":
             from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
 
@@ -155,12 +164,16 @@ class FedAvgAPI(Checkpointable):
             shape = (int(np.prod(config.mesh_shape)),) if config.mesh_shape else None
             self.mesh = make_mesh(shape, axis_names=("clients",))
             self.round_fn = build_sharded_round_fn(
-                model_trainer, config, self.aggregator, self.mesh
+                model_trainer, config, self.aggregator, self.mesh,
+                collect_stats=True
             )
         elif config.silo_threshold > 0:
             from fedml_tpu.algorithms.silo_grouped import (
                 build_silo_round_fn, silo_trainer)
 
+            # the silo-grouped lowering repacks clients into silo groups, so
+            # its outputs don't align with the cohort axis — no ledger stats
+            self._round_has_stats = False
             self.round_fn = build_silo_round_fn(
                 silo_trainer(model_trainer, config.silo_threshold),
                 config, self.aggregator)
@@ -171,7 +184,8 @@ class FedAvgAPI(Checkpointable):
             # keep the non-donating default
             self.round_fn = build_round_fn(
                 model_trainer, config, self.aggregator,
-                donate_data=config.pipeline_depth > 0)
+                donate_data=config.pipeline_depth > 0,
+                collect_stats=True)
         self.eval_fn = build_eval_fn(model_trainer)
         self.client_eval_fn = build_client_eval_fn(model_trainer)
         self._fed_eval_fn = build_federation_eval_fn(model_trainer)
@@ -228,7 +242,17 @@ class FedAvgAPI(Checkpointable):
                     staged.y, staged.counts, rng]
             if staged.participation is not None:
                 args.append(staged.participation)
-            self.global_variables, self.agg_state, train_metrics = self.round_fn(*args)
+            if self._round_has_stats:
+                (self.global_variables, self.agg_state, train_metrics,
+                 stats) = self.round_fn(*args)
+            else:
+                self.global_variables, self.agg_state, train_metrics = \
+                    self.round_fn(*args)
+                stats = None
+        # the drive loops pick the cohort's ledger stats up from here; the
+        # stats arrays stay device-resident until RoundRecordLog's deferred
+        # flush fetch — train_one_round itself never syncs on them
+        self._last_dispatch = (staged, stats)
         with tracer.span("metrics_fetch", round_idx):
             # ONE host round trip for the whole metrics dict — per-key float()
             # was one blocking transfer per metric through the driver tunnel
@@ -236,7 +260,7 @@ class FedAvgAPI(Checkpointable):
 
     def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
               metrics_logger=None, chaos=None, guard=None,
-              tracer=None) -> list[dict[str, Any]]:
+              tracer=None, ledger=None) -> list[dict[str, Any]]:
         """Drive loop. `chaos` (robustness.chaos.FaultPlan) injects a seeded
         deterministic fault schedule per round; `guard`
         (robustness.guard.RoundGuard) inspects every round and, on a bad
@@ -258,7 +282,13 @@ class FedAvgAPI(Checkpointable):
         tracer is installed as the module-level telemetry seam for the
         duration, so the chaos harness, guard, prefetcher, and compile
         cache emit into the same ledger — including from the background
-        staging thread."""
+        staging thread.
+
+        `ledger` (telemetry.client_ledger.ClientLedger) attaches the
+        per-client health ledger: every drive's per-cohort stats rows are
+        scatter-written into it from RoundRecordLog's flush. Attaching a
+        ledger changes NO traced program and adds NO sync points — final
+        params are bit-identical with it on or off."""
         cfg = self.cfg
         owns_tracer = tracer is None
         if tracer is None:
@@ -279,13 +309,16 @@ class FedAvgAPI(Checkpointable):
                     from fedml_tpu.algorithms.buffered import train_buffered
 
                     train_buffered(self, start_round, ckpt_dir, ckpt_every,
-                                   metrics_logger, chaos, guard, tracer)
+                                   metrics_logger, chaos, guard, tracer,
+                                   ledger=ledger)
                 elif cfg.pipeline_depth > 0:
                     self._train_pipelined(start_round, ckpt_dir, ckpt_every,
-                                          metrics_logger, chaos, guard, tracer)
+                                          metrics_logger, chaos, guard,
+                                          tracer, ledger)
                 else:
                     self._train_eager(start_round, ckpt_dir, ckpt_every,
-                                      metrics_logger, chaos, guard, tracer)
+                                      metrics_logger, chaos, guard, tracer,
+                                      ledger)
                 if ckpt_dir:
                     with tracer.span("checkpoint"):
                         self.save_checkpoint(ckpt_dir, cfg.comm_round)
@@ -296,13 +329,14 @@ class FedAvgAPI(Checkpointable):
         return self.history
 
     def _train_eager(self, start_round, ckpt_dir, ckpt_every, metrics_logger,
-                     chaos, guard, tracer) -> None:
+                     chaos, guard, tracer, ledger=None) -> None:
         """Legacy synchronous drive loop: stage, dispatch, block, resolve —
         every phase serialized against the device. Records commit through
         the same `RoundRecordLog` path as the pipelined loop (one code path
         for history/metrics/ledger), flushed every round."""
         cfg = self.cfg
-        records = RoundRecordLog(tracer, self.history, metrics_logger)
+        records = RoundRecordLog(tracer, self.history, metrics_logger,
+                                 ledger=ledger)
         round_idx = start_round
         retries = 0
         while round_idx < cfg.comm_round:
@@ -342,6 +376,9 @@ class FedAvgAPI(Checkpointable):
                                     "the round", verdict.reason)
                         tracer.event("guard_exhausted", round=round_idx)
                 record = {"round": round_idx, "round_time": rspan.elapsed()}
+                block = self._ledger_block(round_idx, *self._last_dispatch)
+                if block is not None:
+                    record["_ledger"] = [block]
                 if faults is not None:
                     record.update(chaos_summary(faults))
                     for k in ("participated_count", "quarantined_count"):
@@ -360,6 +397,23 @@ class FedAvgAPI(Checkpointable):
                     with tracer.span("checkpoint", round_idx):
                         self.save_checkpoint(ckpt_dir, round_idx + 1)
             round_idx += 1
+
+    @staticmethod
+    def _ledger_block(round_idx, staged, stats):
+        """One per-cohort stats block for a round record's `_ledger` key.
+
+        `stats` holds device arrays (possibly mesh-padded past the true
+        cohort — ClientLedger.apply trims to len(client_idx)); they stay
+        unresolved until the record log's single deferred device_get."""
+        if stats is None:
+            return None
+        n = len(staged.client_idx)
+        participated = (np.asarray(staged.faults.participation, bool)[:n]
+                        if staged.faults is not None else np.ones(n, bool))
+        return {"round": round_idx,
+                "client_idx": np.asarray(staged.client_idx),
+                "participated": participated,
+                "stats": stats}
 
     # --------------------------------------------------------- stage seam
     def _stage_cohort(self, round_idx: int, chaos=None, faults=None,
@@ -405,7 +459,8 @@ class FedAvgAPI(Checkpointable):
         return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
 
     def _train_pipelined(self, start_round, ckpt_dir, ckpt_every,
-                         metrics_logger, chaos, guard, tracer) -> None:
+                         metrics_logger, chaos, guard, tracer,
+                         ledger=None) -> None:
         """Asynchronous drive loop (`cfg.pipeline_depth` > 0).
 
         While round t executes, a background stager prepares cohorts
@@ -428,7 +483,8 @@ class FedAvgAPI(Checkpointable):
         # records (possibly holding device-array metrics) defer through the
         # shared RoundRecordLog; structured events (chaos, rollback) hit the
         # ledger the moment they occur, so a crash mid-flush cannot lose them
-        records = RoundRecordLog(tracer, self.history, metrics_logger)
+        records = RoundRecordLog(tracer, self.history, metrics_logger,
+                                 ledger=ledger)
         inflight: deque = deque()
 
         round_idx = start_round
@@ -455,8 +511,13 @@ class FedAvgAPI(Checkpointable):
                                 staged.y, staged.counts, rng]
                         if staged.participation is not None:
                             args.append(staged.participation)
-                        self.global_variables, self.agg_state, train_metrics = \
-                            self.round_fn(*args)
+                        if self._round_has_stats:
+                            (self.global_variables, self.agg_state,
+                             train_metrics, stats) = self.round_fn(*args)
+                        else:
+                            self.global_variables, self.agg_state, \
+                                train_metrics = self.round_fn(*args)
+                            stats = None
                     inflight.append(train_metrics)
                     if len(inflight) > cfg.pipeline_depth:
                         # rounds are serialized on device by the global-variables
@@ -495,6 +556,11 @@ class FedAvgAPI(Checkpointable):
                                         "accepting the round", verdict.reason)
                             tracer.event("guard_exhausted", round=round_idx)
                     record = {"round": round_idx, "round_time": rspan.elapsed()}
+                    block = self._ledger_block(round_idx, staged, stats)
+                    if block is not None:
+                        # stats stay device-resident in the pending record;
+                        # they resolve in the flush's one deferred device_get
+                        record["_ledger"] = [block]
                     if staged.faults is not None:
                         record.update(chaos_summary(staged.faults))
                         for k in ("participated_count", "quarantined_count"):

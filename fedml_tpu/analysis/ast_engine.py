@@ -38,6 +38,12 @@ itself the `bare-suppression` finding):
   `time.time()`/`time.perf_counter()` reads inside a drive loop — async
   dispatch makes them measure the tunnel, not the device. Blessed: the
   telemetry Span API and `jax.block_until_ready`-bracketed timers.
+- `unschema-event`: a `tracer.event(...)` / `telemetry.emit(...)` call whose
+  literal kind string is not registered in EVENT_SCHEMAS — the emit raises
+  ValueError the FIRST time it fires at runtime, which for error-path events
+  (reconnects, rollbacks) is exactly when you can least afford a crash.
+  Non-literal kinds (the seam's own `tracer.event(kind, ...)` forward) are
+  skipped: the rule is a static spelling check, not a dataflow analysis.
 - `full-store-materialize`: `np.asarray(store.x)` / `np.stack(...)` /
   `store.x[:]` whole-store reads over a packed/streaming client store —
   the data plane's O(cohort) contract (data/packed_store.py) dies the
@@ -592,6 +598,59 @@ class _FullStoreMaterialize(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _UnschemaEvent(ast.NodeVisitor):
+    """unschema-event: literal event kinds must exist in EVENT_SCHEMAS.
+
+    Matches the two emit surfaces — the seam (`telemetry.emit(...)` or a
+    bare `emit(...)` from `from fedml_tpu.telemetry import emit`) and tracer
+    methods (`<anything>.event(...)`, e.g. `tracer.event`,
+    `self.tracer.event`). The kind is the first positional string literal,
+    or the `kind=` keyword; calls passing a variable are skipped (the
+    tracer's own runtime check owns those)."""
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        # late import keeps analysis importable even if telemetry grows
+        # heavier deps; tracer.py is stdlib-only today
+        from fedml_tpu.telemetry.tracer import EVENT_SCHEMAS
+        self.schemas = EVENT_SCHEMAS
+
+    @staticmethod
+    def _is_emit_call(name: str) -> bool:
+        if name == "emit":
+            return True
+        parts = name.split(".")
+        if parts[-1] == "emit" and parts[-2:-1] == ["telemetry"]:
+            return True
+        # tracer.event / self.tracer.event — but not a bare event() name
+        return parts[-1] == "event" and len(parts) > 1
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name and self._is_emit_call(name):
+            kind = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "kind" and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        kind = kw.value.value
+            if kind is not None and kind not in self.schemas \
+                    and not is_suppressed(self.lines, node.lineno,
+                                          "unschema-event"):
+                self.findings.append(Finding(
+                    "unschema-event", f"{self.path}:{node.lineno}",
+                    f"event kind {kind!r} is not in EVENT_SCHEMAS — this "
+                    f"call raises ValueError the first time it fires; "
+                    f"register the kind (with its required fields) in "
+                    f"telemetry/tracer.py"))
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -608,6 +667,7 @@ def lint_source(source: str, path: str) -> List[Finding]:
         if info.traced:
             _RuleRunner(info, path, lines, findings).visit(info.node)
     _SyncIdiom(path, lines, findings).visit(tree)
+    _UnschemaEvent(path, lines, findings).visit(tree)
     _FullStoreMaterialize(path, lines, findings,
                           _blessed_store_ranges(col)).visit(tree)
     # drive-loop fetch hygiene is an algorithms/-driver contract: that is

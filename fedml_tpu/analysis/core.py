@@ -57,6 +57,10 @@ RULES = {
     "rng-key-reuse": "PRNG key consumed by two jitted calls without an "
                      "intervening fold_in/split (identical randomness)",
     "bare-suppression": "graft-lint: disable comment without a '-- reason'",
+    "unschema-event": "tracer.event()/telemetry.emit() with a literal kind "
+                      "that is not in EVENT_SCHEMAS (the call raises "
+                      "ValueError the first time it fires at runtime — "
+                      "often in a rarely-hit error path)",
 }
 
 # Suppression grammar: `# graft-lint: disable=rule1,rule2 -- reason`.

@@ -109,6 +109,15 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="TensorBoard trace dir for --profile_rounds "
                              "(default <run_dir>/trace)")
+    parser.add_argument("--trace_max_mb", type=float, default=0,
+                        help="rotate TRACE.jsonl when it exceeds this many "
+                             "MB (archived as TRACE.jsonl.NNN; 0 = never)")
+    # graft-ledger client-health observability (telemetry/client_ledger.py):
+    # out-of-core per-client counters fed by the round programs' stats
+    # vector; read back with tools/client_report.py
+    parser.add_argument("--client_ledger_dir", type=str, default=None,
+                        help="directory for the mmap-backed per-client "
+                             "health ledger (None = ledger off)")
     return parser
 
 
@@ -148,12 +157,14 @@ def tracer_from_args(args, metrics_logger=None):
     profile_dir = getattr(args, "profile_dir", None)
     if profile_dir is None and run_dir:
         profile_dir = os.path.join(run_dir, "trace")
+    max_mb = getattr(args, "trace_max_mb", 0) or 0
     return telemetry.Tracer(
         jsonl_path=jsonl,
         metrics_logger=metrics_logger if getattr(args, "trace_wandb", 0)
         else None,
         profile_rounds=getattr(args, "profile_rounds", None),
         profile_dir=profile_dir,
+        max_bytes=int(max_mb * 2 ** 20) or None,
         run_meta={"model": args.model, "dataset": args.dataset,
                   "clients": args.client_num_in_total,
                   "clients_per_round": args.client_num_per_round,
@@ -161,14 +172,26 @@ def tracer_from_args(args, metrics_logger=None):
                   "pipeline_depth": args.pipeline_depth})
 
 
+def ledger_from_args(args, num_clients: int):
+    """The run's ClientLedger (--client_ledger_dir), or None. The ledger is
+    opened against the dataset's FULL client population — its disk footprint
+    is O(num_clients), its per-round write is O(cohort)."""
+    ledger_dir = getattr(args, "client_ledger_dir", None)
+    if not ledger_dir:
+        return None
+    from fedml_tpu.telemetry.client_ledger import open_or_create
+
+    return open_or_create(ledger_dir, num_clients)
+
+
 def config_from_args(args) -> FedConfig:
     d = {k: v for k, v in vars(args).items() if v is not None}
     d.pop("data_dir", None)
     d.pop("ckpt_dir", None)
     d.pop("run_dir", None)
-    # observability knobs configure the tracer, not the round program
+    # observability knobs configure the tracer/ledger, not the round program
     for k in ("trace_summary", "trace_wandb", "profile_rounds",
-              "profile_dir"):
+              "profile_dir", "trace_max_mb", "client_ledger_dir"):
         d.pop(k, None)
     if d.get("mesh_shape"):
         d["mesh_shape"] = tuple(d["mesh_shape"])

@@ -15,6 +15,7 @@ import argparse
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.experiments.common import (
     add_args,
+    ledger_from_args,
     robustness_from_args,
     setup_run,
     tracer_from_args,
@@ -32,11 +33,15 @@ def main(argv=None, aggregator_name: str = "fedavg", extra_args=None):
     api = FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
     chaos, guard = robustness_from_args(args)
     tracer = tracer_from_args(args, metrics_logger=logger)
+    ledger = ledger_from_args(args, ds.client_num)
     try:
         history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger,
-                            chaos=chaos, guard=guard, tracer=tracer)
+                            chaos=chaos, guard=guard, tracer=tracer,
+                            ledger=ledger)
     finally:
         tracer.close()
+        if ledger is not None:
+            ledger.close()
     logger.finish()
     if getattr(args, "trace_summary", 0):
         print(tracer.summary_table(), flush=True)

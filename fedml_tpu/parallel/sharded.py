@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.algorithms.aggregators import quarantine_stage
-from fedml_tpu.algorithms.engine import build_local_update
+from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import shard_map
 from fedml_tpu.utils.pytree import tree_where
@@ -37,6 +37,7 @@ def build_sharded_round_fn(
     aggregator,
     mesh: Mesh,
     axis: str = "clients",
+    collect_stats: bool = False,
 ) -> Callable:
     """Jitted multi-chip round: shard_map(local train) + psum-aggregation.
 
@@ -69,6 +70,11 @@ def build_sharded_round_fn(
         result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
             global_variables, x, y, counts, crngs
         )
+        # ledger stats are plain per-client rows of the LOCAL shard (no
+        # cross-client reductions in cohort_stats), returned under P(axis):
+        # zero new collectives, so the legacy COMMS budget is untouched
+        stats = cohort_stats(global_variables, result) if collect_stats \
+            else None
         weights = counts.astype(jnp.float32)
         if participation is not None:
             result, weights, alive, quarantined = quarantine_stage(
@@ -85,6 +91,8 @@ def build_sharded_round_fn(
         )
         metrics = {k: jax.lax.psum(v.sum(), axis) for k, v in result.metrics.items()}
         if participation is None:
+            if collect_stats:
+                return new_global, new_state, metrics, stats
             return new_global, new_state, metrics
         alive_total = jax.lax.psum(alive.sum(), axis)
         # psum outputs are invariant-typed, so the no-op guard's select is
@@ -95,7 +103,13 @@ def build_sharded_round_fn(
         metrics["participated_count"] = alive_total.astype(jnp.float32)
         metrics["quarantined_count"] = jax.lax.psum(
             quarantined.sum(), axis).astype(jnp.float32)
+        if collect_stats:
+            return new_global, new_state, metrics, stats
         return new_global, new_state, metrics
+
+    # stats rows stay client-sharded end to end: concatenating the device
+    # shards under P(axis) reproduces the staged cohort order exactly
+    out_specs = (P(), P(), P()) + ((P(axis),) if collect_stats else ())
 
     def round_fn(global_variables, agg_state, x, y, counts, rng,
                  participation=None):
@@ -104,14 +118,14 @@ def build_sharded_round_fn(
                 shard_body,
                 mesh=mesh,
                 in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
-                out_specs=(P(), P(), P()),
+                out_specs=out_specs,
             )
             return sharded(global_variables, agg_state, x, y, counts, rng)
         sharded = shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(axis)),
-            out_specs=(P(), P(), P()),
+            out_specs=out_specs,
         )
         return sharded(global_variables, agg_state, x, y, counts, rng,
                        participation)

@@ -309,7 +309,8 @@ def _aggregate_sharded(aggregator, gv_shard, gv_full, result, result_shard,
 def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                           sharding: TensorSharding,
                           donate_state: bool = True,
-                          donate_data: bool = False) -> Callable:
+                          donate_data: bool = False,
+                          collect_stats: bool = False) -> Callable:
     """Jitted tensor-sharded round over sharding.mesh — the runtime the
     rule tables exist for.
 
@@ -330,7 +331,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
     the engine's opt-in cohort-buffer donation for the pipelined loop.
     """
     from fedml_tpu.algorithms.aggregators import quarantine_stage
-    from fedml_tpu.algorithms.engine import build_local_update
+    from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
 
     mesh = sharding.mesh
     n_cl = mesh.shape[CLIENT_AXIS]
@@ -350,6 +351,11 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             gv_full = _gather_tree(gv_shard, specs_gv)
             result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
                 gv_full, x, y, counts, crngs)
+            # ledger stats: per-client rows from the FULL (gathered) result,
+            # so they are invariant over the tensor axis by the same
+            # argument as result.metrics — check_vma accepts the
+            # PS(CLIENT_AXIS) out-spec with zero new collectives
+            stats = cohort_stats(gv_full, result) if collect_stats else None
             weights = counts.astype(jnp.float32)
             if participation is not None:
                 result, weights, alive, quarantined = quarantine_stage(
@@ -362,6 +368,8 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             metrics = {k: jax.lax.psum(v.sum(), CLIENT_AXIS)
                        for k, v in result.metrics.items()}
             if participation is None:
+                if collect_stats:
+                    return new_gshard, new_st, metrics, stats
                 return new_gshard, new_st, metrics
             alive_total = jax.lax.psum(alive.sum(), CLIENT_AXIS)
             any_alive = alive_total > 0
@@ -370,14 +378,19 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             metrics["participated_count"] = alive_total.astype(jnp.float32)
             metrics["quarantined_count"] = jax.lax.psum(
                 quarantined.sum(), CLIENT_AXIS).astype(jnp.float32)
+            if collect_stats:
+                return new_gshard, new_st, metrics, stats
             return new_gshard, new_st, metrics
 
         data_specs = (PS(CLIENT_AXIS), PS(CLIENT_AXIS), PS(CLIENT_AXIS))
         in_specs = (specs_gv, specs_st) + data_specs + (PS(),)
         if masked:
             in_specs = in_specs + (PS(CLIENT_AXIS),)
+        out_specs = (specs_gv, specs_st, PS())
+        if collect_stats:
+            out_specs = out_specs + (PS(CLIENT_AXIS),)
         fn = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
-                       out_specs=(specs_gv, specs_st, PS()))
+                       out_specs=out_specs)
         donate: Tuple[int, ...] = ()
         if donate_state:
             donate += (0, 1)

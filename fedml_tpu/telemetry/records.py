@@ -46,10 +46,11 @@ class RoundRecordLog:
     to history + metrics logger + the telemetry ledger."""
 
     def __init__(self, tracer=None, history: Optional[List[Dict]] = None,
-                 metrics_logger=None):
+                 metrics_logger=None, ledger=None):
         self.tracer = tracer or NULL_TRACER
         self.history = history if history is not None else []
         self.metrics_logger = metrics_logger
+        self.ledger = ledger
         self._pending: List[Dict[str, Any]] = []
 
     def __len__(self) -> int:
@@ -68,6 +69,16 @@ class RoundRecordLog:
                               records=len(pending)):
             pending = jax.device_get(pending)
         for rec in pending:
+            # the reserved _ledger key carries per-cohort stats blocks
+            # (already host arrays after the device_get above — stats ride
+            # the SAME deferred fetch, no extra sync); it never reaches
+            # history/metrics, and without an attached ledger it is dropped
+            blocks = rec.pop("_ledger", None)
+            if self.ledger is not None and blocks:
+                with self.tracer.span("ledger_write", round_idx,
+                                      blocks=len(blocks)):
+                    for block in blocks:
+                        self.ledger.apply(block)
             rec = {k: _scalar(v) for k, v in rec.items()}
             self.history.append(rec)
             if self.metrics_logger is not None:

@@ -32,12 +32,24 @@ _WORKLOAD_KEYS = ("model", "clients", "clients_per_round", "batch_size")
 
 
 def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Parse a TRACE.jsonl leniently: a run killed mid-write (OOM, SIGKILL
+    during a chaos drive) leaves a truncated final line, and fold() crashing
+    on it would lose the entire otherwise-valid trace. Unparseable lines are
+    counted, not fatal; the count rides along as a synthetic
+    `truncated_lines` record so fold() can surface it in the report."""
     records = []
+    truncated = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 records.append(json.loads(line))
+            except ValueError:
+                truncated += 1
+    if truncated:
+        records.append({"type": "truncated_lines", "count": truncated})
     return records
 
 
@@ -139,6 +151,10 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "coverage": round(coverage(records), 4),
         "phases": {name: _pcts(durs) for name, durs in sorted(by_name.items())},
         "events": dict(sorted(event_counts.items())),
+        # lenient-load accounting: >0 means the trace lost its tail
+        # (load_trace skipped that many unparseable lines)
+        "truncated_lines": sum(r.get("count", 0) for r in records
+                               if r.get("type") == "truncated_lines"),
     }
     if compile_counts is not None:
         report["compile"] = compile_counts

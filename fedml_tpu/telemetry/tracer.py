@@ -74,6 +74,12 @@ EVENT_SCHEMAS: Dict[str, set] = {
     "buffer_committed": {"round", "size", "staleness_p50", "staleness_max"},
     # data plane download retries (data/acquire.py), mirroring mqtt_reconnect
     "download_retry": {"attempt", "status", "backoff_s"},
+    # JSONL sink rotation (--trace_max_mb): last record of a retired segment
+    # names its archive file, so fold() can chain segments back together
+    "trace_rotated": {"rotated_to", "segment", "bytes"},
+    # client-health fleet report (tools/client_report.py): one per flagged
+    # client — quarantine recidivist or update-norm z-score outlier
+    "client_flagged": {"client", "reason", "value"},
 }
 
 
@@ -114,7 +120,8 @@ class Tracer:
                  profile_rounds: Optional[str] = None,
                  profile_dir: Optional[str] = None,
                  run_meta: Optional[Dict[str, Any]] = None,
-                 mode: str = "w"):
+                 mode: str = "w",
+                 max_bytes: Optional[int] = None):
         self._clock = clock or time.perf_counter
         self._lock = threading.Lock()
         self.spans: List[Dict[str, Any]] = []
@@ -127,13 +134,20 @@ class Tracer:
         self._profile_dir = profile_dir or "/tmp/fedml_tpu_trace"
         self._profiling = False
         self._file = None
+        self._jsonl_path = jsonl_path
+        self._max_bytes = max_bytes
+        self._bytes = 0
+        self._segment = 0
         if jsonl_path:
             parent = os.path.dirname(jsonl_path)
             if parent:  # ckpt_dir may not exist until the first save
                 os.makedirs(parent, exist_ok=True)
             self._file = open(jsonl_path, mode)
-        self._write({"type": "meta", "version": 1, "clock": "monotonic",
-                     **(run_meta or {})})
+            if mode == "a" and os.path.exists(jsonl_path):
+                self._bytes = os.path.getsize(jsonl_path)
+        self._meta_rec = {"type": "meta", "version": 1, "clock": "monotonic",
+                          **(run_meta or {})}
+        self._write(self._meta_rec)
 
     # ------------------------------------------------------------- plumbing
     def now(self) -> float:
@@ -144,8 +158,36 @@ class Tracer:
     def _write(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             if self._file is not None:
-                self._file.write(json.dumps(rec, default=float) + "\n")
+                line = json.dumps(rec, default=float) + "\n"
+                self._file.write(line)
                 self._file.flush()  # durable the moment it happened
+                self._bytes += len(line)
+                if self._max_bytes and self._bytes >= self._max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Retire the live JSONL segment (caller holds self._lock): archive
+        it as `<path>.NNN`, reopen fresh, and re-write the meta record so
+        every segment is self-describing. The `trace_rotated` event is
+        appended to the retired file FIRST (its last line names the archive
+        it becomes), then constructed directly — calling self.event() here
+        would deadlock on the non-reentrant lock."""
+        archive = f"{self._jsonl_path}.{self._segment:03d}"
+        rec = {"type": "event", "kind": "trace_rotated", "t": self.now(),
+               "thread": _thread_label(), "rotated_to": archive,
+               "segment": self._segment, "bytes": self._bytes}
+        line = json.dumps(rec, default=float) + "\n"
+        self._file.write(line)
+        self._file.flush()
+        self._file.close()
+        os.replace(self._jsonl_path, archive)
+        self.events.append(rec)
+        self._segment += 1
+        self._file = open(self._jsonl_path, "w")
+        meta_line = json.dumps(self._meta_rec, default=float) + "\n"
+        self._file.write(meta_line)
+        self._file.flush()
+        self._bytes = len(meta_line)
 
     # ---------------------------------------------------------------- spans
     @contextmanager

@@ -1,0 +1,356 @@
+"""Client health ledger (ISSUE 11): mmap column semantics and the ledger
+on/off bit-identity pin.
+
+The load-bearing claims:
+  - attaching a ClientLedger to ANY drive loop (eager, pipelined, buffered
+    with stragglers, tensor-sharded) changes no traced program and no rng
+    stream — final params are BITWISE identical with the ledger on or off;
+  - the ledger itself is deterministic: two same-seed chaos runs produce
+    byte-identical shard files and identical folded reports (the flagged
+    set is stable, so a CI gate on it cannot flap);
+  - ledger counters cross-check the chaos plan exactly — drop_count totals
+    equal the plan's dispatch-time drops, quarantine totals its surviving
+    NaN injections;
+  - EMAs seed from the first HEALTHY observation and quarantined rounds
+    never touch them;
+  - scatter writes land in the right shard at any clients_per_shard, and
+    apply() trims mesh-padded stats rows.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.telemetry.client_ledger import (
+    COLUMNS,
+    ClientLedger,
+    create_ledger,
+    open_or_create,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import client_report  # noqa: E402  (tools/client_report.py)
+
+
+def _bitwise_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _drive(ds, ledger, chaos=None, rounds=4, **cfg_kwargs):
+    """Run a fresh FedAvgAPI drive loop; returns the final params tree."""
+    cfg = FedConfig(comm_round=rounds, batch_size=8, epochs=1, lr=0.05,
+                    client_num_in_total=ds.client_num,
+                    client_num_per_round=ds.client_num,
+                    seed=0, ci=1, frequency_of_the_test=10 ** 9,
+                    **cfg_kwargs)
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    api = FedAvgAPI(ds, cfg, trainer)
+    api.train(chaos=chaos, ledger=ledger)
+    return api.global_variables
+
+
+_CHAOS = FaultPlan(seed=3, drop_rate=0.2, nan_rate=0.1)
+
+# every drive the repo ships, each with the seeded chaos plan that
+# exercises its ledger path (the buffered drive adds stragglers so the
+# staleness column fills too)
+DRIVES = [
+    pytest.param({}, _CHAOS, id="eager"),
+    pytest.param({"pipeline_depth": 2}, _CHAOS, id="pipelined-depth2"),
+    pytest.param({"buffer_size": 3},
+                 FaultPlan(seed=3, drop_rate=0.2, nan_rate=0.1,
+                           straggler_rate=0.4, straggler_rounds=2),
+                 id="buffered-stragglers"),
+    pytest.param({"tensor_shards": 4}, _CHAOS, id="tensor-sharded"),
+]
+
+
+# ------------------------------------------------- ledger on/off bit identity
+
+@pytest.mark.parametrize("cfg_kwargs,chaos", DRIVES)
+def test_ledger_on_off_params_bitwise(ds8, tmp_path, cfg_kwargs, chaos):
+    """Attaching the ledger is pure observation: the round programs always
+    return the stats rows (collect_stats=True), so whether a ledger
+    scatter-writes them host-side cannot move a single bit of the model."""
+    params_off = _drive(ds8, None, chaos=chaos, **cfg_kwargs)
+    ledger = create_ledger(str(tmp_path / "led"), ds8.client_num)
+    try:
+        params_on = _drive(ds8, ledger, chaos=chaos, **cfg_kwargs)
+        assert _bitwise_equal(params_off, params_on)
+
+        # dispatch-time accounting must reproduce the chaos plan exactly:
+        # the plan is pure in (seed, round), so totals are closed-form
+        part = ledger.column("participation_count")
+        drop = ledger.column("drop_count")
+        quar = ledger.column("quarantine_count")
+        events = [chaos.events(r, ds8.client_num) for r in range(4)]
+        assert int(drop.sum()) == sum(e.dropped for e in events)
+        assert int(part.sum()) == sum(
+            int(e.participation.sum()) for e in events)
+        assert int(quar.sum()) == sum(
+            int((e.participation & e.nan_mask).sum()) for e in events)
+        assert int(ledger.column("last_seen_round").max()) <= 3
+    finally:
+        ledger.close()
+
+
+def test_buffered_straggler_staleness_lands_in_ledger(ds8, tmp_path):
+    """The buffered drive's commit-time staleness blocks attribute rounds of
+    lateness to the clients that straggled — the plan says who."""
+    chaos = FaultPlan(seed=3, drop_rate=0.2, nan_rate=0.1,
+                      straggler_rate=0.4, straggler_rounds=2)
+    ledger = create_ledger(str(tmp_path / "led"), ds8.client_num)
+    try:
+        _drive(ds8, ledger, chaos=chaos, buffer_size=3)
+        # the seeded plan must actually produce stragglers for this test to
+        # mean anything; latencies() is pure so this is a stable property
+        planned = sum(int(chaos.latencies(r, ds8.client_num).sum())
+                      for r in range(4))
+        assert planned > 0
+        stale = ledger.column("staleness_sum")
+        assert int(stale.sum()) > 0
+        # staleness only ever accrues to clients that were dispatched
+        assert not np.any((stale > 0)
+                          & (ledger.column("participation_count") == 0))
+    finally:
+        ledger.close()
+
+
+def _ledger_file_bytes(root: str) -> dict:
+    return {fn: open(os.path.join(root, fn), "rb").read()
+            for fn in sorted(os.listdir(root))}
+
+
+def test_same_seed_chaos_runs_yield_byte_identical_shards(ds8, tmp_path):
+    """Two same-seed buffered chaos runs write byte-identical ledger files
+    and fold to the identical report — the flagged set cannot flap."""
+    chaos = FaultPlan(seed=3, drop_rate=0.2, nan_rate=0.1,
+                      straggler_rate=0.4, straggler_rounds=2)
+    reports = []
+    dirs = []
+    for tag in ("a", "b"):
+        root = str(tmp_path / f"led_{tag}")
+        ledger = create_ledger(root, ds8.client_num)
+        try:
+            _drive(ds8, ledger, chaos=chaos, buffer_size=3)
+            reports.append(client_report.fold_ledger(
+                ledger, z_threshold=1.0, recidivist_min=1))
+        finally:
+            ledger.close()
+        dirs.append(root)
+    bytes_a, bytes_b = map(_ledger_file_bytes, dirs)
+    assert sorted(bytes_a) == sorted(bytes_b)
+    for fn in bytes_a:
+        assert bytes_a[fn] == bytes_b[fn], f"{fn} differs across runs"
+    # identical flagged sets (json round-trip = exact structural equality)
+    assert json.dumps(reports[0], sort_keys=True) == \
+        json.dumps(reports[1], sort_keys=True)
+
+
+# ------------------------------------------------------- column unit semantics
+
+def test_create_layout_shards_and_fills(tmp_path):
+    root = str(tmp_path / "led")
+    ledger = create_ledger(root, 10, clients_per_shard=4)
+    assert ledger.shard_rows == [4, 4, 2]
+    for shard, rows in enumerate(ledger.shard_rows):
+        for column, dtype, _ in COLUMNS:
+            path = os.path.join(root, f"ledger_{shard:05d}.{column}")
+            assert os.path.getsize(path) == rows * np.dtype(dtype).itemsize
+    # fills: -1 for "never seen", zero everywhere else
+    assert np.all(ledger.column("last_seen_round") == -1)
+    for column in ("participation_count", "drop_count", "quarantine_count",
+                   "staleness_sum", "ema_update_norm", "ema_loss"):
+        assert np.all(ledger.column(column) == 0)
+    ledger.close()
+
+
+def test_update_counters_and_ema_seeding(tmp_path):
+    led = create_ledger(str(tmp_path / "led"), 10, clients_per_shard=4)
+    # round 0: client 1 healthy, client 5 quarantined (NaN), client 9 dropped
+    led.update(0, client_idx=[1, 5, 9],
+               participated=[True, True, False],
+               update_norm=[1.0, 2.0, 3.0],
+               finite=[True, False, True],
+               loss_sum=[2.0, 4.0, 6.0], total=[2.0, 2.0, 2.0])
+    assert led.column("participation_count")[[1, 5, 9]].tolist() == [1, 1, 0]
+    assert led.column("drop_count")[[1, 5, 9]].tolist() == [0, 0, 1]
+    assert led.column("quarantine_count")[[1, 5, 9]].tolist() == [0, 1, 0]
+    assert led.column("last_seen_round")[[1, 5, 9]].tolist() == [0, 0, -1]
+    # EMA seeded from the first healthy observation only: the quarantined
+    # and dropped clients' EMAs stay untouched at 0
+    assert led.column("ema_update_norm")[[1, 5, 9]].tolist() == [1.0, 0.0, 0.0]
+    assert led.column("ema_loss")[[1, 5, 9]].tolist() == [1.0, 0.0, 0.0]
+
+    # round 3: both healthy. client 1 decays (seen before: 1 healthy obs);
+    # client 5's only prior round was quarantined, so it SEEDS fresh now
+    led.update(3, client_idx=[1, 5], participated=[True, True],
+               update_norm=[3.0, 4.0], finite=[True, True],
+               loss_sum=[4.0, 8.0], total=[2.0, 2.0])
+    norm = led.column("ema_update_norm")
+    loss = led.column("ema_loss")
+    assert norm[1] == pytest.approx(0.9 * 1.0 + 0.1 * 3.0)
+    assert norm[5] == pytest.approx(4.0)
+    assert loss[1] == pytest.approx(0.9 * 1.0 + 0.1 * 2.0)
+    assert loss[5] == pytest.approx(4.0)
+    assert led.column("last_seen_round")[[1, 5]].tolist() == [3, 3]
+    led.close()
+
+
+def test_multi_shard_scatter_roundtrip(tmp_path):
+    """One cohort spanning all shards: every row lands in the right shard
+    and column() reassembles the global order."""
+    led = create_ledger(str(tmp_path / "led"), 10, clients_per_shard=4)
+    idx = [0, 3, 4, 7, 8, 9]  # shards 0, 0, 1, 1, 2, 2
+    led.update(5, client_idx=idx,
+               participated=[True] * 6,
+               update_norm=[float(i) for i in idx],
+               finite=[True] * 6,
+               loss_sum=[0.0] * 6, total=[1.0] * 6)
+    part = led.column("participation_count")
+    assert part[idx].tolist() == [1] * 6
+    assert int(part.sum()) == 6
+    norm = led.column("ema_update_norm")
+    assert norm[idx].tolist() == [float(i) for i in idx]
+    led.add_staleness([3, 8], [2, 5])
+    stale = led.column("staleness_sum")
+    assert stale[[3, 8]].tolist() == [2, 5]
+    assert int(stale.sum()) == 7
+    with pytest.raises(IndexError):
+        led.update(0, client_idx=[10], participated=[True],
+                   update_norm=[0.0], finite=[True],
+                   loss_sum=[0.0], total=[1.0])
+    led.close()
+
+
+def test_apply_trims_mesh_padding_and_rejects_unknown_blocks(tmp_path):
+    led = create_ledger(str(tmp_path / "led"), 8)
+    # stats vectors padded to 4 rows for a 2-row cohort (mesh padding):
+    # apply() must drop the synthetic tail
+    led.apply({"round": 2, "client_idx": np.array([6, 1]),
+               "participated": np.array([True, True, False, False]),
+               "stats": {"update_norm": np.array([1.0, 2.0, 99.0, 99.0]),
+                         "finite": np.array([True, True, False, False]),
+                         "loss_sum": np.array([2.0, 2.0, 9.0, 9.0]),
+                         "total": np.array([2.0, 1.0, 1.0, 1.0])}})
+    assert int(led.column("participation_count").sum()) == 2
+    assert led.column("ema_update_norm")[[6, 1]].tolist() == [1.0, 2.0]
+    led.apply({"round": 3, "client_idx": np.array([6]),
+               "staleness": np.array([4, 9, 9])})  # padded staleness too
+    assert int(led.column("staleness_sum").sum()) == 4
+    with pytest.raises(ValueError, match="unknown ledger block"):
+        led.apply({"round": 0, "client_idx": np.array([0])})
+    led.close()
+
+
+def test_open_or_create_resumes_and_rejects_mismatch(tmp_path):
+    root = str(tmp_path / "led")
+    led = open_or_create(root, 10, clients_per_shard=4)
+    led.update(0, client_idx=[2], participated=[True], update_norm=[5.0],
+               finite=[True], loss_sum=[1.0], total=[1.0])
+    led.close()
+    reopened = open_or_create(root, 10)
+    assert reopened.shard_rows == [4, 4, 2]  # header wins over the default
+    assert int(reopened.column("participation_count")[2]) == 1
+    assert float(reopened.column("ema_update_norm")[2]) == 5.0
+    reopened.close()
+    with pytest.raises(ValueError, match="holds 10 clients"):
+        open_or_create(root, 11)
+
+
+# ------------------------------------------------------------- fleet report
+
+def _report_ledger(tmp_path, n=20):
+    """Hand-built ledger: client 3 a quarantine recidivist, client 7 an
+    update-norm outlier, clients 15..19 never sampled."""
+    led = create_ledger(str(tmp_path / "report_led"), n, clients_per_shard=8)
+    for r in range(4):
+        idx = np.arange(15)
+        healthy = np.ones(15, bool)
+        healthy[3] = r >= 3  # quarantined rounds 0-2, healthy round 3
+        norm = np.full(15, 1.0)
+        norm[7] = 50.0  # persistent outlier
+        led.update(r, client_idx=idx, participated=np.ones(15, bool),
+                   update_norm=norm, finite=healthy,
+                   loss_sum=np.full(15, 2.0), total=np.full(15, 2.0))
+    return led
+
+
+def test_fold_ledger_flags_recidivists_and_outliers(tmp_path):
+    led = _report_ledger(tmp_path)
+    try:
+        report = client_report.fold_ledger(led, z_threshold=3.0,
+                                           recidivist_min=2)
+    finally:
+        led.close()
+    assert report["num_clients"] == 20
+    assert report["participating"] == 15
+    assert report["coverage"] == pytest.approx(0.75)
+    assert report["rounds_seen"] == 4
+    assert report["quarantine_total"] == 3
+    assert report["drop_total"] == 0
+    assert report["recidivists"] == [{"client": 3, "quarantine_count": 3}]
+    assert [o["client"] for o in report["outliers"]] == [7]
+    flagged = {(f["client"], f["reason"]) for f in report["flagged"]}
+    assert flagged == {(3, "quarantine_recidivist"), (7, "update_norm_outlier")}
+    assert report["flagged_fraction"] == pytest.approx(2 / 15, abs=1e-6)
+    # sync drives: zero staleness means everything in the first bin
+    assert report["staleness_hist"]["counts"][0] == 15
+    assert sum(report["staleness_hist"]["counts"]) == 15
+
+
+def test_coverage_counts_sampled_not_just_alive(tmp_path):
+    """A client the chaos plan dropped every round was still SAMPLED — only
+    clients the cohort draw never touched count against coverage."""
+    led = create_ledger(str(tmp_path / "cov_led"), 4)
+    led.update(0, client_idx=[0, 1], participated=[True, False],
+               update_norm=[1.0, 0.0], finite=[True, True],
+               loss_sum=[1.0, 0.0], total=[1.0, 1.0])
+    try:
+        report = client_report.fold_ledger(led)
+    finally:
+        led.close()
+    assert report["participating"] == 1   # client 0 only
+    assert report["sampled"] == 2         # the dropped client 1 counts
+    assert report["coverage"] == pytest.approx(0.5)
+
+
+def test_report_gate_pass_and_trip(tmp_path, capsys):
+    led = _report_ledger(tmp_path)
+    led.close()
+    root = str(tmp_path / "report_led")
+    out = str(tmp_path / "report.json")
+    # lenient thresholds: gate passes, artifact written
+    rc = client_report.main([root, "--gate", "--coverage_floor", "0.5",
+                             "--flagged_ceiling", "0.5", "--out", out])
+    assert rc == 0
+    assert "client-health gate: PASS" in capsys.readouterr().out
+    with open(out) as f:
+        assert json.load(f)["participating"] == 15
+    # a zero flagged ceiling must trip on the recidivist + outlier
+    rc = client_report.main([root, "--gate", "--flagged_ceiling", "0"])
+    assert rc == 1
+    assert "client-health gate: FAIL" in capsys.readouterr().out
+    # an unreachable coverage floor trips too
+    rc = client_report.main([root, "--gate", "--coverage_floor", "0.9"])
+    assert rc == 1

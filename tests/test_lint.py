@@ -240,6 +240,70 @@ def test_ast_untraced_code_is_not_flagged():
     assert not [f for f in _findings(src) if f.rule == "host-transfer"]
 
 
+# ----------------------------------------------------------- unschema-event
+
+def _event_findings(src):
+    return [f for f in lint_source(src, "fixture.py")
+            if f.rule == "unschema-event"]
+
+
+def test_unschema_event_fires_on_seam_emit_with_unknown_kind():
+    src = (
+        "from fedml_tpu import telemetry\n"
+        "def f():\n"
+        "    telemetry.emit('totally_made_up_kind', x=1)\n")
+    findings = _event_findings(src)
+    assert findings and "totally_made_up_kind" in findings[0].message
+
+
+def test_unschema_event_fires_on_tracer_event_and_kind_kwarg():
+    src = (
+        "def f(tracer):\n"
+        "    tracer.event('bogus_event', round=0)\n"
+        "    tracer.event(kind='also_bogus', round=0)\n"
+        "def g(self):\n"
+        "    self.tracer.event('nested_bogus', round=0)\n")
+    assert len(_event_findings(src)) == 3
+
+
+def test_unschema_event_clean_on_registered_kinds():
+    src = (
+        "from fedml_tpu import telemetry\n"
+        "def f(tracer):\n"
+        "    telemetry.emit('chaos_inject', round=0, dropped=0, nan=0,\n"
+        "                   corrupt=0)\n"
+        "    tracer.event('round_committed', round=0)\n")
+    assert not _event_findings(src)
+
+
+def test_unschema_event_skips_non_literal_kind():
+    # the seam's own forward (tracer.event(kind, ...)) passes a variable —
+    # a static spelling check must not flag dataflow it cannot see
+    src = (
+        "def forward(tracer, kind, fields):\n"
+        "    tracer.event(kind, **fields)\n")
+    assert not _event_findings(src)
+
+
+def test_unschema_event_suppression_works():
+    src = (
+        "def f(tracer):\n"
+        "    # graft-lint: disable=unschema-event -- kind registered "
+        "downstream\n"
+        "    tracer.event('future_kind', round=0)\n")
+    assert not _event_findings(src)
+
+
+def test_unschema_event_ignores_unrelated_event_and_emit_names():
+    # a bare event() function call (no attribute) is not a tracer surface
+    src = (
+        "def event(name):\n"
+        "    return name\n"
+        "def f():\n"
+        "    return event('not_telemetry')\n")
+    assert not _event_findings(src)
+
+
 # -------------------------------------------- blocking-fetch-in-drive-loop
 
 def _drive_findings(src):

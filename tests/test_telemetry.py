@@ -108,6 +108,8 @@ _SAMPLE_EVENTS = {
     "buffer_committed": dict(round=3, size=4, staleness_p50=1.0,
                              staleness_max=2.0),
     "download_retry": dict(attempt=0, status="503", backoff_s=1.5),
+    "trace_rotated": dict(rotated_to="TRACE.jsonl.000", segment=0, bytes=1024),
+    "client_flagged": dict(client=17, reason="quarantine_recidivist", value=3),
 }
 
 
@@ -146,6 +148,76 @@ def test_events_are_flushed_to_jsonl_before_close(tmp_path):
         lines = [json.loads(ln) for ln in f if ln.strip()]
     assert lines[-1]["kind"] == "chaos_inject" and lines[-1]["round"] == 3
     t.close()
+
+
+def test_trace_rotation_archives_segments_and_reopens(tmp_path):
+    """--trace_max_mb: the sink rotates at the byte cap; the retired file's
+    LAST line is the trace_rotated event naming its archive, and the fresh
+    segment re-writes the meta record so every file is self-describing."""
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path, max_bytes=600, run_meta={"model": "lr"})
+    for i in range(30):
+        t.event("checkpoint_save", step=i)
+    t.close()
+    archives = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name != "TRACE.jsonl")
+    assert archives, "no rotation happened under a 600-byte cap"
+    assert archives == [f"TRACE.jsonl.{i:03d}" for i in range(len(archives))]
+    steps = []
+    for name in archives + ["TRACE.jsonl"]:
+        records = load_trace(str(tmp_path / name))
+        assert records[0]["type"] == "meta" and records[0]["model"] == "lr"
+        # cap + the line that crossed it + the trace_rotated marker
+        assert os.path.getsize(tmp_path / name) <= 600 + 300
+        if name != "TRACE.jsonl":
+            last = records[-1]
+            assert last["kind"] == "trace_rotated"
+            assert last["rotated_to"].endswith(name)
+            steps.extend(r["step"] for r in records
+                         if r.get("kind") == "checkpoint_save")
+        else:
+            steps.extend(r["step"] for r in records
+                         if r.get("kind") == "checkpoint_save")
+    assert steps == list(range(30))  # chained segments lose nothing
+    # the in-memory ledger saw the rotation events too
+    assert len(t.find_events("trace_rotated")) == len(archives)
+
+
+def test_trace_rotation_append_mode_counts_existing_bytes(tmp_path):
+    path = str(tmp_path / "TRACE.jsonl")
+    with open(path, "w") as f:
+        f.write("x" * 500 + "\n")
+    t = Tracer(jsonl_path=path, mode="a", max_bytes=600)
+    t.event("checkpoint_save", step=0)  # pushes past the cap -> rotates
+    t.close()
+    assert (tmp_path / "TRACE.jsonl.000").exists()
+
+
+def test_load_trace_skips_truncated_final_line(tmp_path):
+    """A run killed mid-write leaves a partial last line; fold() must keep
+    the valid prefix and surface the loss as truncated_lines."""
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path)
+    with t.span("drive"):
+        with t.round(0):
+            pass
+    t.event("checkpoint_save", step=0)
+    t.close()
+    with open(path, "a") as f:
+        f.write('{"type": "event", "kind": "round_com')  # the torn write
+    records = load_trace(path)
+    report = fold(records)
+    assert report["truncated_lines"] == 1
+    assert report["events"].get("checkpoint_save") == 1  # prefix survived
+    assert report["rounds"] == 1
+
+
+def test_load_trace_clean_file_reports_zero_truncated(tmp_path):
+    path = str(tmp_path / "TRACE.jsonl")
+    t = Tracer(jsonl_path=path)
+    t.event("checkpoint_save", step=0)
+    t.close()
+    assert fold(load_trace(path))["truncated_lines"] == 0
 
 
 def test_emit_seam_routes_to_installed_tracer_and_noops_bare():

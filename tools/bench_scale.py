@@ -23,7 +23,11 @@ Env knobs:
                                             (the O(cohort) Feistel sampler)
 
 Point mode flags (what ci_smoke's scale smoke drives directly):
-  --point --clients N [--rounds R] [--rss_budget_mb M]
+  --point --clients N [--rounds R] [--rss_budget_mb M] [--ledger]
+`--ledger` attaches a full-federation client-health ledger
+(telemetry/client_ledger.py) to the drive: its mmap columns cover every
+client, but per-round scatter writes touch O(cohort) pages, so the RSS
+budget must hold with the ledger on.
 `--rss_budget_mb` turns the point into a gate: exit 1 when the child's
 peak RSS exceeds the budget (the JSON line still prints, with
 `rss_budget_exceeded: true`, so the caller can say by how much).
@@ -67,7 +71,7 @@ def _dir_logical_bytes(d: str) -> int:
 
 
 def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
-              fast_sampling: bool = False) -> int:
+              fast_sampling: bool = False, use_ledger: bool = False) -> int:
     import resource
 
     from fedml_tpu.utils.cache import enable_compile_cache
@@ -85,6 +89,7 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
     from fedml_tpu.models.registry import create_model
 
     store_dir = tempfile.mkdtemp(prefix=f"bench_scale_{clients}_")
+    ledger = ledger_dir = None
     try:
         t0 = time.perf_counter()
         create_synthetic_store(store_dir, clients, n_max=N_MAX,
@@ -106,12 +111,29 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
         trainer = ClassificationTrainer(create_model("lr", output_dim=CLASSES))
         api = FedAvgAPI(ds, cfg, trainer)
 
-        api.train_one_round(0)  # compile + warm (outside the timed window)
+        # optional client-health ledger over the FULL federation: the mmap
+        # columns are the scale story's second axis — per-round writes touch
+        # O(cohort) pages, so a 1M-client ledger must not move peak RSS
+        if use_ledger:
+            from fedml_tpu.telemetry.client_ledger import create_ledger
+            ledger_dir = tempfile.mkdtemp(prefix=f"bench_ledger_{clients}_")
+            ledger = create_ledger(ledger_dir, clients)
+
+        def step(r: int) -> None:
+            api.train_one_round(r)
+            if ledger is not None:
+                staged, stats = api._last_dispatch
+                block = FedAvgAPI._ledger_block(r, staged,
+                                                jax.device_get(stats))
+                if block is not None:
+                    ledger.apply(block)
+
+        step(0)  # compile + warm (outside the timed window)
         t0 = time.perf_counter()
         for r in range(rounds):
             # train_one_round's metrics_fetch is one blocking device_get, so
             # each iteration measures completed work, not async dispatch
-            api.train_one_round(r + 1)
+            step(r + 1)
         timed_s = time.perf_counter() - t0
 
         peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
@@ -126,6 +148,16 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
             "platform": jax.devices()[0].platform,
             "fast_sampling": fast_sampling,
         }
+        if ledger is not None:
+            ledger.flush()
+            result["ledger"] = {
+                "participating": int((ledger.column("participation_count")
+                                      > 0).sum()),
+                "logical_mb": round(_dir_logical_bytes(ledger_dir) / 2**20, 1),
+                "physical_mb": round(
+                    _dir_physical_bytes(ledger_dir) / 2**20, 1),
+            }
+            ledger.close()
         rc = 0
         if rss_budget_mb is not None:
             result["rss_budget_mb"] = rss_budget_mb
@@ -136,6 +168,8 @@ def run_point(clients: int, rounds: int, rss_budget_mb: float | None,
         return rc
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)
+        if ledger_dir:
+            shutil.rmtree(ledger_dir, ignore_errors=True)
 
 
 def run_sweep(rounds: int) -> None:
@@ -200,10 +234,15 @@ def main():
     ap.add_argument("--fast_sampling", action="store_true",
                     help="sample cohorts with the O(cohort) Feistel "
                          "sampler instead of the O(N) default")
+    ap.add_argument("--ledger", action="store_true",
+                    help="attach a full-federation client-health ledger to "
+                         "the point (RSS must stay flat: O(cohort) pages "
+                         "touched per round)")
     args = ap.parse_args()
     if args.point:
         raise SystemExit(run_point(args.clients, args.rounds,
-                                   args.rss_budget_mb, args.fast_sampling))
+                                   args.rss_budget_mb, args.fast_sampling,
+                                   use_ledger=args.ledger))
     run_sweep(args.rounds)
 
 

@@ -1,0 +1,230 @@
+"""Fold a client-health ledger into a fleet report, optionally gating CI.
+
+The ledger (telemetry/client_ledger.py) accumulates per-client counters on
+disk as the drive loop runs; this CLI is the read side — the fleet view a
+million-client operator actually triages from:
+
+- participation coverage: fraction of clients ever sampled, plus the Gini
+  coefficient of the participation-count distribution (0 = perfectly even
+  sampling, ->1 = a few clients dominate the cohort draw);
+- staleness histogram: mean commit staleness per participating client
+  (buffered drives only — sync drives have no staleness by construction);
+- quarantine recidivists: clients quarantined on >= --recidivist_min
+  distinct rounds — a persistent NaN producer is a data problem at that
+  client, not transient chaos;
+- update-norm outliers: top-k clients whose EMA update L2-norm sits more
+  than --z_threshold standard deviations from the healthy-population mean
+  (the classic poisoned-or-broken-client signature).
+
+Flagged clients (recidivists + outliers) are appended to the run's
+TRACE.jsonl as schema-checked `client_flagged` events when --trace is
+given, so the event ledger stays the one place downstream tooling reads.
+
+Usage:
+  python tools/client_report.py RUN_DIR/ledger                 # fold + print
+  python tools/client_report.py ledger --trace RUN/TRACE.jsonl # + flag events
+  python tools/client_report.py ledger --gate --coverage_floor 0.2 \
+      --flagged_ceiling 0.1                                    # CI gate
+
+--gate exit-1 conditions:
+  coverage below --coverage_floor; flagged fraction (of participating
+  clients) above --flagged_ceiling; or, when --trace is given, the ledger's
+  quarantine_count total disagreeing with the trace's round_committed
+  quarantined_count total — the two are independent accounting paths for
+  the same events, so a mismatch means one of them is lying.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.telemetry.client_ledger import ClientLedger  # noqa: E402
+from fedml_tpu.telemetry.report import load_trace  # noqa: E402
+from fedml_tpu.telemetry.tracer import Tracer  # noqa: E402
+
+#: staleness-histogram bin edges (mean commit staleness, in rounds); the
+#: last bin is open-ended
+STALENESS_EDGES = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0)
+
+
+def gini(x: np.ndarray) -> float:
+    """Gini coefficient of a non-negative count vector (0 = uniform)."""
+    x = np.sort(x.astype(np.float64))
+    n = len(x)
+    total = x.sum()
+    if n == 0 or total == 0:
+        return 0.0
+    i = np.arange(1, n + 1, dtype=np.float64)
+    return float(((2.0 * i - n - 1.0) * x).sum() / (n * total))
+
+
+def fold_ledger(ledger: ClientLedger, z_threshold: float = 3.0,
+                top_k: int = 10, recidivist_min: int = 2) -> dict:
+    """Ledger columns -> fleet report dict (pure numpy, deterministic)."""
+    part = ledger.column("participation_count").astype(np.int64)
+    drop = ledger.column("drop_count").astype(np.int64)
+    quar = ledger.column("quarantine_count").astype(np.int64)
+    stale = ledger.column("staleness_sum").astype(np.int64)
+    last_seen = ledger.column("last_seen_round")
+    norm = ledger.column("ema_update_norm").astype(np.float64)
+    loss = ledger.column("ema_loss").astype(np.float64)
+
+    n = len(part)
+    participating = part > 0
+    # coverage is a SAMPLER property: a client the chaos plan dropped every
+    # round was still sampled (drop_count > 0), only a client the cohort
+    # draw never touched is starved
+    sampled = (part + drop) > 0
+
+    # staleness histogram over mean-staleness of participating clients
+    mean_stale = np.where(participating, stale / np.maximum(part, 1), 0.0)
+    edges = list(STALENESS_EDGES) + [np.inf]
+    hist, _ = np.histogram(mean_stale[participating], bins=edges)
+
+    # quarantine recidivists, worst first (count desc, then client id asc
+    # for a deterministic flagged set across same-seed runs)
+    rec_idx = np.nonzero(quar >= recidivist_min)[0]
+    rec_order = np.lexsort((rec_idx, -quar[rec_idx]))
+    recidivists = [{"client": int(rec_idx[i]),
+                    "quarantine_count": int(quar[rec_idx[i]])}
+                   for i in rec_order]
+
+    # update-norm z-score outliers over the healthy population: clients
+    # with at least one non-quarantined observation (their EMA is seeded)
+    healthy = (part - quar) > 0
+    outliers = []
+    if healthy.sum() >= 2:
+        h_norm = norm[healthy]
+        mu, sd = float(h_norm.mean()), float(h_norm.std())
+        if sd > 0:
+            z = np.zeros(n)
+            z[healthy] = (norm[healthy] - mu) / sd
+            out_idx = np.nonzero(np.abs(z) > z_threshold)[0]
+            out_order = np.lexsort((out_idx, -np.abs(z[out_idx])))[:top_k]
+            outliers = [{"client": int(out_idx[i]),
+                         "z": round(float(z[out_idx[i]]), 4),
+                         "ema_update_norm": float(norm[out_idx[i]])}
+                        for i in out_order]
+
+    flagged = ([{"client": r["client"], "reason": "quarantine_recidivist",
+                 "value": r["quarantine_count"]} for r in recidivists]
+               + [{"client": o["client"], "reason": "update_norm_outlier",
+                   "value": o["z"]} for o in outliers])
+    n_part = int(participating.sum())
+    return {
+        "num_clients": n,
+        "participating": n_part,
+        "sampled": int(sampled.sum()),
+        "coverage": round(int(sampled.sum()) / n, 6) if n else 0.0,
+        "participation_gini": round(gini(part), 6),
+        "rounds_seen": int(last_seen.max()) + 1 if n_part else 0,
+        "drop_total": int(drop.sum()),
+        "quarantine_total": int(quar.sum()),
+        "staleness_hist": {"edges": [e for e in STALENESS_EDGES],
+                           "counts": [int(c) for c in hist]},
+        "mean_ema_loss": (round(float(loss[healthy].mean()), 6)
+                          if healthy.any() else None),
+        "recidivists": recidivists,
+        "outliers": outliers,
+        "flagged": flagged,
+        "flagged_fraction": (round(len(flagged) / n_part, 6)
+                             if n_part else 0.0),
+    }
+
+
+def trace_quarantined_total(trace_path: str) -> tuple:
+    """(sum of round_committed quarantined_count, truncated-line count)
+    from a TRACE.jsonl — the cross-check's other accounting path."""
+    records = load_trace(trace_path)
+    total = 0
+    for r in records:
+        if r.get("type") == "event" and r.get("kind") == "round_committed":
+            total += int(r.get("quarantined_count", 0))
+    truncated = sum(r.get("count", 0) for r in records
+                    if r.get("type") == "truncated_lines")
+    return total, truncated
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("ledger", help="ledger directory (holds ledger.json)")
+    parser.add_argument("--trace", default=None,
+                        help="TRACE.jsonl to append client_flagged events to "
+                             "and cross-check quarantine accounting against")
+    parser.add_argument("--out", default=None,
+                        help="write the report JSON here")
+    parser.add_argument("--top_k", type=int, default=10,
+                        help="max update-norm outliers to flag")
+    parser.add_argument("--z_threshold", type=float, default=3.0,
+                        help="|z| above which an EMA update norm is flagged")
+    parser.add_argument("--recidivist_min", type=int, default=2,
+                        help="quarantine count at which a client is flagged")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit 1 when a fleet-health floor/ceiling trips")
+    parser.add_argument("--coverage_floor", type=float, default=0.0,
+                        help="--gate fails when participation coverage is "
+                             "below this fraction")
+    parser.add_argument("--flagged_ceiling", type=float, default=1.0,
+                        help="--gate fails when flagged clients exceed this "
+                             "fraction of participating clients")
+    args = parser.parse_args(argv)
+
+    ledger = ClientLedger(args.ledger)
+    report = fold_ledger(ledger, z_threshold=args.z_threshold,
+                         top_k=args.top_k,
+                         recidivist_min=args.recidivist_min)
+
+    if args.trace:
+        trace_total, truncated = trace_quarantined_total(args.trace)
+        report["trace_quarantined_total"] = trace_total
+        report["trace_truncated_lines"] = truncated
+        # the flagged set goes into the SAME event ledger the run wrote, as
+        # schema-checked events (mode="a": the run's records stay intact)
+        with Tracer(jsonl_path=args.trace, mode="a",
+                    run_meta={"tool": "client_report"}) as tracer:
+            for f in report["flagged"]:
+                tracer.event("client_flagged", **f)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps(report))
+
+    if not args.gate:
+        return 0
+    failures = []
+    if report["coverage"] < args.coverage_floor:
+        failures.append(
+            f"coverage {report['coverage']} below floor "
+            f"{args.coverage_floor} — the sampler is starving clients")
+    if report["flagged_fraction"] > args.flagged_ceiling:
+        failures.append(
+            f"flagged fraction {report['flagged_fraction']} above ceiling "
+            f"{args.flagged_ceiling} "
+            f"({len(report['flagged'])} flagged client(s))")
+    if args.trace and report["quarantine_total"] != \
+            report["trace_quarantined_total"]:
+        failures.append(
+            f"ledger quarantine_total {report['quarantine_total']} != trace "
+            f"round_committed quarantined_count total "
+            f"{report['trace_quarantined_total']} — the two accounting "
+            f"paths disagree")
+    if failures:
+        print("client-health gate: FAIL\n  " + "\n  ".join(failures))
+        return 1
+    print(f"client-health gate: PASS (coverage {report['coverage']}, "
+          f"{len(report['flagged'])} flagged, quarantine accounting "
+          f"consistent)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
