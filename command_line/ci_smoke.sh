@@ -285,6 +285,90 @@ print(f"OK buffered rerun byte-identical: {a._buffer_host.commits} commits, "
       f"{a._buffer_host.committed_updates} updates")
 EOF
 
+echo "== graft-serve smoke (two tenants, one mesh: fedavg + buffered, both commit)"
+python - <<'EOF'
+# a sync-fedavg tenant and a partial-dispatch buffered tenant interleaved
+# through the fair-share scheduler over the packed mnist store: both jobs
+# must commit, the trace must carry per-tenant round spans and one
+# job_committed event each, and the sync tenant must be byte-identical to
+# running its job solo through the classic drive loop
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.serving import JobDescriptor, Scheduler
+from fedml_tpu.telemetry.tracer import Tracer
+
+ds = load_dataset("mnist", client_num_in_total=8, partition_method="homo")
+sync_cfg = FedConfig(comm_round=2, epochs=1, batch_size=4, lr=0.05,
+                     client_num_in_total=8, client_num_per_round=8)
+buf_cfg = FedConfig(comm_round=2, epochs=1, batch_size=4, lr=0.03, seed=1,
+                    client_num_in_total=8, client_num_per_round=8,
+                    buffer_size=5, staleness_alpha=0.5)
+tracer = Tracer()
+sched = Scheduler(policy="fair_share", tracer=tracer)
+sched.submit(JobDescriptor(name="sync", config=sync_cfg, dataset=ds))
+sched.submit(JobDescriptor(name="buf", config=buf_cfg, dataset=ds,
+                           chaos=FaultPlan(seed=7, straggler_rate=0.5,
+                                           straggler_rounds=2),
+                           partial_dispatch=True, weight=2.0))
+sched.run()
+assert all(j.done for j in sched.queue), [j.state for j in sched.queue]
+committed = {e["job"] for e in tracer.find_events("job_committed")}
+assert committed == {"sync", "buf"}, committed
+jobs = tracer.job_summary()
+assert set(jobs) == {"sync", "buf"} and all(
+    p["round"]["count"] == 2 for p in jobs.values()), jobs
+
+solo = FedAvgAPI(ds, sync_cfg,
+                 ClassificationTrainer(create_model("lr", output_dim=10)))
+solo.train()
+for a, b in zip(jax.tree.leaves(sched.queue.get("sync").final_params()),
+                jax.tree.leaves(jax.device_get(solo.global_variables))):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+        "served sync tenant diverged from its solo run"
+print(f"OK graft-serve: 2 tenants committed in {sched.ticks} ticks, "
+      f"compile ledger={sched.compile_ledger}")
+EOF
+
+echo "== serving compile-budget self-test: a cache-blowing tenant must FAIL"
+python - <<'EOF'
+# synthetic ledger one request over the eager drive's pinned max_compiles:
+# the per-tenant gate must FAIL that tenant (and only that tenant), proving
+# the serving half of the compile budget is live
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import json
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.serving import JobDescriptor, Scheduler
+
+ds = load_dataset("mnist", client_num_in_total=2, partition_method="homo")
+cfg = FedConfig(comm_round=1, epochs=1, batch_size=4,
+                client_num_in_total=2, client_num_per_round=2)
+sched = Scheduler()
+sched.submit(JobDescriptor(name="polite", config=cfg, dataset=ds))
+sched.submit(JobDescriptor(name="blower", config=cfg.replace(seed=1),
+                           dataset=ds))
+budgets = json.load(open("COMPILE_BUDGET.json"))
+ceiling = budgets["eager"]["max_compiles"]
+sched.compile_ledger["polite"]["requests"] = ceiling
+sched.compile_ledger["blower"]["requests"] = ceiling + 1
+ok, report = sched.check_compile_budgets(budgets)
+print(report)
+assert not ok, "per-tenant compile gate failed to trip"
+lines = report.splitlines()
+assert any(l.startswith("OK tenant=polite") for l in lines), report
+assert any(l.startswith("FAIL tenant=blower") for l in lines), report
+print("OK serving compile gate trips on one request over the eager ceiling")
+EOF
+
 echo "== perf-regression gate (ROADMAP item 5): TRACE rounds/s vs BENCH baseline"
 rm -f /tmp/ci_gate_trace.jsonl
 BENCH_PIPE_ROUNDS=10 BENCH_PIPE_REPS=2 BENCH_PIPE_DEPTHS=0 BENCH_PIPE_MODEL=lr \

@@ -150,59 +150,92 @@ class _HostState:
         self.row_clients = list(row_clients)
 
 
-def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
-                   metrics_logger, chaos, guard, tracer,
-                   discount_fn=None, ledger=None) -> None:
-    """The buffered drive loop (`cfg.buffer_size > 0`), called from
-    FedAvgAPI.train() under its tracer/checkpoint scaffolding.
+class BufferedRunner:
+    """One buffered tenant's admit/commit machinery as a schedulable unit.
 
-    Per dispatch round t: stage the cohort (through the SAME `stage_fn` seam
-    as the synchronous loops — with `cfg.pipeline_depth > 0` a background
-    prefetcher stages rounds t+1..t+depth while t executes), run the
-    client-step program against the current globals, schedule each
-    surviving client's arrival at t + latency (seeded straggler plan; 0
-    without chaos), then admit every update whose arrival round is t and
-    commit whenever the buffer reaches K. After the last dispatch round the
-    outstanding arrivals drain on virtual rounds, and a final partial
-    buffer flushes through the participation-masked commit path."""
-    cfg = api.cfg
-    k = int(cfg.buffer_size)
-    if k < 1:
-        raise ValueError(f"buffer_size must be >= 1 in buffered mode, got {k}")
-    if discount_fn is None:
-        discount_fn = make_staleness_discount(cfg.staleness_alpha)
-    donate_buffer = guard is None
-    admit_fn = build_buffer_admit(donate_buffer=donate_buffer)
-    commit_fn = build_buffer_commit(api.aggregator, discount_fn)
-    # stats are always collected (the traced program must not depend on
-    # whether a ledger happens to be attached — ledger on/off bit-identity);
-    # the admit/commit programs are untouched
-    client_step = build_client_step_fn(api.trainer, cfg, donate_data=True,
-                                       collect_stats=True)
-    records = RoundRecordLog(tracer, api.history, metrics_logger,
-                             ledger=ledger)
-    prefetcher = None
-    if cfg.pipeline_depth > 0:
-        prefetcher = CohortPrefetcher(
-            lambda r: api.stage_fn(r, chaos=chaos), depth=cfg.pipeline_depth)
-        api._last_prefetcher = prefetcher  # test/ops introspection
+    Owns the device buffer, the host-side arrival schedule (`_HostState`),
+    and the three jitted programs (client_step / admit / commit), exposing
+    ONE dispatch round as `step()` plus the end-of-drive `drain()` — so the
+    classic `train_buffered` loop below and the multi-tenant serving
+    scheduler (`fedml_tpu.serving`) drive the SAME code path and a tenant's
+    admit/commit sequence is bit-identical to running its job solo.
 
-    host = _HostState()
-    api._buffer = None  # device buffer; exposed for tests/introspection
-    api._buffer_host = host
+    `partial_dispatch=True` (the FedBuff follow-up PR 9 deferred): instead
+    of re-running the full cohort every dispatch round, only as many
+    replacement clients are dispatched as arrivals have freed capacity
+    (`capacity() = cohort - in_flight`) — the caller stages that prefix of
+    the round's seeded sample, padded back to the cohort's static width
+    (`FedAvgAPI.stage_partial_cohort`) so the client_step signature — and
+    therefore the compile budget — never changes. A zero-capacity round
+    passes `staged=None` to `step()`, which skips the dispatch program
+    entirely and only processes arrivals. With no stragglers, capacity is
+    always the full cohort and partial mode degenerates bit-exactly into
+    full dispatch."""
 
-    def base_rng(round_idx: int, salt: int):
-        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), round_idx)
+    def __init__(self, api, chaos=None, guard=None, discount_fn=None,
+                 partial_dispatch: bool = False):
+        cfg = api.cfg
+        k = int(cfg.buffer_size)
+        if k < 1:
+            raise ValueError(
+                f"buffer_size must be >= 1 in buffered mode, got {k}")
+        if discount_fn is None:
+            discount_fn = make_staleness_discount(cfg.staleness_alpha)
+        self.api = api
+        self.cfg = cfg
+        self.k = k
+        self.chaos = chaos
+        self.partial_dispatch = bool(partial_dispatch)
+        # a guard snapshot holds the buffer's arrays — donation would
+        # deallocate them (the donate-when-restageable rule)
+        self.admit_fn = build_buffer_admit(donate_buffer=guard is None)
+        self.commit_fn = build_buffer_commit(api.aggregator, discount_fn)
+        # stats are always collected (the traced program must not depend on
+        # whether a ledger happens to be attached — ledger on/off
+        # bit-identity); the admit/commit programs are untouched
+        self.client_step = build_client_step_fn(
+            api.trainer, cfg, donate_data=True, collect_stats=True)
+        self.host = _HostState()
+        # dispatched-but-unadmitted updates: partial mode's capacity counter
+        # (full mode maintains it too — it is pure bookkeeping there)
+        self.in_flight = 0
+        api._buffer = None  # device buffer; exposed for tests/introspection
+        api._buffer_host = self.host
+
+    def base_rng(self, round_idx: int, salt: int = 0):
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
         if salt:
             rng = jax.random.fold_in(rng, salt)
         return rng
 
-    def do_commit(commit_round: int, rng_round, seq: int, commit_metrics,
-                  ledger_blocks):
-        """One buffer commit; returns the commit's device metric dict."""
+    def capacity(self, cohort: int) -> int:
+        """How many replacement clients the next dispatch round may stage:
+        the full cohort in classic mode, `cohort - in_flight` in partial
+        mode (never negative)."""
+        if not self.partial_dispatch:
+            return cohort
+        return max(0, cohort - self.in_flight)
+
+    # -- guard snapshot/rollback: jax pytrees are immutable, so holding refs
+    # IS the device snapshot; the host schedule needs explicit copies
+    def snapshot(self):
+        return (self.api._ckpt_tree(), self.api._ckpt_meta(),
+                self.api._buffer, self.host.snapshot(), self.in_flight)
+
+    def restore(self, snap) -> None:
+        tree, meta, buf, host_snap, in_flight = snap
+        self.api._ckpt_load(tree, meta)
+        self.api._buffer = buf
+        self.host.restore(host_snap)
+        self.in_flight = in_flight
+
+    def _do_commit(self, commit_round: int, rng_round, seq: int,
+                   commit_metrics, ledger_blocks, tracer) -> None:
+        """One buffer commit; appends the commit's device metric dict."""
+        api, host = self.api, self.host
         rng = rng_round if seq == 0 else jax.random.fold_in(rng_round, seq)
         with tracer.span("commit", commit_round):
-            api.global_variables, api.agg_state, m = commit_fn(
+            api.global_variables, api.agg_state, m = self.commit_fn(
                 api.global_variables, api.agg_state, api._buffer,
                 np.int32(commit_round), rng)
         staleness = [commit_round - b for b in host.births]
@@ -227,19 +260,21 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
         api._buffer = dict(api._buffer, fill=jnp.zeros((), jnp.int32))
         commit_metrics.append(m)
 
-    def process_arrivals(now: int, rng_round, commit_metrics,
-                         ledger_blocks, seq_base: int) -> int:
+    def process_arrivals(self, now: int, rng_round, commit_metrics,
+                         ledger_blocks, seq_base: int, tracer) -> int:
         """Admit round `now`'s due arrivals in (birth, slot) order; commit
         every time the buffer fills. Returns the number of commits made."""
+        api, host = self.api, self.host
         due = sorted(host.arrivals.pop(now, []))
         n_commits = 0
         for birth, slot in due:
             src = host.pending[birth]
             with tracer.span("admit", now):
-                api._buffer = admit_fn(
+                api._buffer = self.admit_fn(
                     api._buffer, src["vars"], src["steps"], src["metrics"],
                     src["counts"], np.int32(slot), np.int32(birth))
             host.fill += 1
+            self.in_flight -= 1
             host.births.append(birth)
             # host numpy row (pending stores client_idx as np.asarray at
             # dispatch), so this index is a host read, not a device fetch
@@ -249,11 +284,120 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
             src["remaining"] -= 1
             if src["remaining"] == 0:
                 del host.pending[birth]
-            if host.fill == k:
-                do_commit(now, rng_round, seq_base + n_commits,
-                          commit_metrics, ledger_blocks)
+            if host.fill == self.k:
+                self._do_commit(now, rng_round, seq_base + n_commits,
+                                commit_metrics, ledger_blocks, tracer)
                 n_commits += 1
         return n_commits
+
+    def step(self, round_idx: int, staged, rng_round, tracer) -> dict:
+        """One dispatch round: run the client-step program over `staged`
+        (skipped when None — a zero-capacity partial round), schedule each
+        surviving client's arrival at round + latency (seeded straggler
+        plan; 0 without chaos), then admit/commit round `round_idx`'s due
+        arrivals. Returns {ledger_blocks, commit_metrics, n_commits}."""
+        api, host = self.api, self.host
+        ledger_blocks: list = []
+        if staged is not None:
+            with tracer.span("dispatch", round_idx):
+                result, stats = self.client_step(
+                    api.global_variables, staged.x, staged.y,
+                    staged.counts, rng_round)
+            if api._buffer is None:
+                api._buffer = init_buffer(result, self.k)
+            n = len(staged.client_idx)
+            lat = (self.chaos.latencies(round_idx, n)
+                   if self.chaos is not None
+                   else np.zeros(n, np.int32)).tolist()
+            surviving = [c for c in range(n)
+                         if staged.faults is None
+                         or bool(staged.faults.participation[c])]
+            for c in surviving:
+                host.arrivals.setdefault(
+                    round_idx + lat[c], []).append((round_idx, c))
+            self.in_flight += len(surviving)
+            if surviving:
+                host.pending[round_idx] = {
+                    "vars": result.variables,
+                    "steps": result.num_steps,
+                    "metrics": result.metrics,
+                    "counts": staged.counts,
+                    # slot -> global client id, read back at admit time
+                    # for the ledger's staleness attribution
+                    "client_idx": np.asarray(staged.client_idx),
+                    "remaining": len(surviving),
+                }
+            participated = (
+                np.asarray(staged.faults.participation, bool)
+                if staged.faults is not None else np.ones(n, bool))
+            ledger_blocks.append({
+                "round": round_idx,
+                "client_idx": np.asarray(staged.client_idx),
+                "participated": participated,
+                "stats": stats})
+        commit_metrics: list = []
+        n_commits = self.process_arrivals(round_idx, rng_round,
+                                          commit_metrics, ledger_blocks,
+                                          0, tracer)
+        telemetry.gauge("buffer_fill", round=round_idx,
+                        fill=host.fill, commits=n_commits)
+        return {"ledger_blocks": ledger_blocks,
+                "commit_metrics": commit_metrics,
+                "n_commits": n_commits}
+
+    def drain(self, tracer) -> dict:
+        """Outstanding straggler arrivals land on virtual rounds past the
+        last dispatch, then the final partial buffer flushes through the
+        masked commit path (participation = arange(K) < fill). No new
+        client work runs here, so the schedule stays a pure function of
+        the seed. Returns {ledger_blocks, commit_metrics, n_commits}."""
+        host = self.host
+        drain_round = self.cfg.comm_round
+        commit_metrics: list = []
+        ledger_blocks: list = []
+        n_commits = 0
+        while host.arrivals:
+            rng_round = self.base_rng(drain_round, 0)
+            n_commits += self.process_arrivals(drain_round, rng_round,
+                                               commit_metrics, ledger_blocks,
+                                               0, tracer)
+            drain_round += 1
+        if host.fill > 0:
+            self._do_commit(drain_round, self.base_rng(drain_round, 0), 0,
+                            commit_metrics, ledger_blocks, tracer)
+            n_commits += 1
+        return {"ledger_blocks": ledger_blocks,
+                "commit_metrics": commit_metrics,
+                "n_commits": n_commits,
+                "drain_round": drain_round}
+
+
+def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
+                   metrics_logger, chaos, guard, tracer,
+                   discount_fn=None, ledger=None) -> None:
+    """The buffered drive loop (`cfg.buffer_size > 0`), called from
+    FedAvgAPI.train() under its tracer/checkpoint scaffolding.
+
+    Per dispatch round t: stage the cohort (through the SAME `stage_fn` seam
+    as the synchronous loops — with `cfg.pipeline_depth > 0` a background
+    prefetcher stages rounds t+1..t+depth while t executes), then hand the
+    round to the `BufferedRunner`: run the client-step program against the
+    current globals, schedule each surviving client's arrival at
+    t + latency, admit every update whose arrival round is t, and commit
+    whenever the buffer reaches K. After the last dispatch round the
+    runner's `drain()` lands the outstanding arrivals on virtual rounds and
+    flushes the final partial buffer."""
+    cfg = api.cfg
+    runner = BufferedRunner(api, chaos=chaos, guard=guard,
+                            discount_fn=discount_fn)
+    host = runner.host
+    records = RoundRecordLog(tracer, api.history, metrics_logger,
+                             ledger=ledger)
+    prefetcher = None
+    if cfg.pipeline_depth > 0:
+        prefetcher = CohortPrefetcher(
+            lambda r: api.stage_fn(r, chaos=chaos), depth=cfg.pipeline_depth)
+        api._last_prefetcher = prefetcher  # test/ops introspection
 
     round_idx = start_round
     retries = 0
@@ -271,51 +415,12 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                             prefetcher.prefetch(round_idx + ahead)
                 snapshot = None
                 if guard is not None:
-                    # jax pytrees are immutable: holding refs IS the device
-                    # snapshot; the host schedule needs explicit copies
-                    snapshot = (api._ckpt_tree(), api._ckpt_meta(),
-                                api._buffer, host.snapshot())
-                rng_round = base_rng(round_idx, retries)
-                with tracer.span("dispatch", round_idx):
-                    result, stats = client_step(
-                        api.global_variables, staged.x, staged.y,
-                        staged.counts, rng_round)
-                if api._buffer is None:
-                    api._buffer = init_buffer(result, k)
-                n = len(staged.client_idx)
-                lat = (chaos.latencies(round_idx, n) if chaos is not None
-                       else np.zeros(n, np.int32)).tolist()
-                surviving = [c for c in range(n)
-                             if staged.faults is None
-                             or bool(staged.faults.participation[c])]
-                for c in surviving:
-                    host.arrivals.setdefault(
-                        round_idx + lat[c], []).append((round_idx, c))
-                if surviving:
-                    host.pending[round_idx] = {
-                        "vars": result.variables,
-                        "steps": result.num_steps,
-                        "metrics": result.metrics,
-                        "counts": staged.counts,
-                        # slot -> global client id, read back at admit time
-                        # for the ledger's staleness attribution
-                        "client_idx": np.asarray(staged.client_idx),
-                        "remaining": len(surviving),
-                    }
-                participated = (
-                    np.asarray(staged.faults.participation, bool)
-                    if staged.faults is not None else np.ones(n, bool))
-                ledger_blocks: list = [{
-                    "round": round_idx,
-                    "client_idx": np.asarray(staged.client_idx),
-                    "participated": participated,
-                    "stats": stats}]
-                commit_metrics: list = []
-                n_commits = process_arrivals(round_idx, rng_round,
-                                             commit_metrics, ledger_blocks,
-                                             seq_base=0)
-                telemetry.gauge("buffer_fill", round=round_idx,
-                                fill=host.fill, commits=n_commits)
+                    snapshot = runner.snapshot()
+                rng_round = runner.base_rng(round_idx, retries)
+                out = runner.step(round_idx, staged, rng_round, tracer)
+                ledger_blocks = out["ledger_blocks"]
+                commit_metrics = out["commit_metrics"]
+                n_commits = out["n_commits"]
                 train_metrics: dict = {}
                 if commit_metrics:
                     with tracer.span("metrics_fetch", round_idx):
@@ -340,9 +445,7 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
                             verdict.reason, retries, guard.max_retries)
                         tracer.event("guard_rollback", round=round_idx,
                                      retry=retries)
-                        api._ckpt_load(snapshot[0], snapshot[1])
-                        api._buffer = snapshot[2]
-                        host.restore(snapshot[3])
+                        runner.restore(snapshot)
                         if prefetcher:
                             prefetcher.invalidate()
                         continue
@@ -381,32 +484,18 @@ def train_buffered(api, start_round: int, ckpt_dir, ckpt_every,
         if prefetcher:
             prefetcher.close()
 
-    # -- drain: outstanding straggler arrivals land on virtual rounds past
-    # the last dispatch, then the final partial buffer flushes through the
-    # masked commit path (participation = arange(K) < fill). No new client
-    # work runs here, so the schedule stays a pure function of the seed.
-    drain_round = cfg.comm_round
-    commit_metrics = []
-    drain_ledger_blocks: list = []
-    drain_commits = 0
-    while host.arrivals:
-        rng_round = base_rng(drain_round, 0)
-        drain_commits += process_arrivals(drain_round, rng_round,
-                                          commit_metrics,
-                                          drain_ledger_blocks, seq_base=0)
-        drain_round += 1
-    if host.fill > 0:
-        do_commit(drain_round, base_rng(drain_round, 0), 0, commit_metrics,
-                  drain_ledger_blocks)
-        drain_commits += 1
-    if drain_commits:
+    # -- drain: the runner lands the outstanding straggler arrivals on
+    # virtual rounds and flushes the final partial buffer (see
+    # BufferedRunner.drain)
+    out = runner.drain(tracer)
+    if out["n_commits"]:
         record = {"round": cfg.comm_round, "round_time": 0.0,
-                  "buffer_commits": drain_commits,
+                  "buffer_commits": out["n_commits"],
                   "committed_updates": host.committed_updates,
                   "buffer_fill": host.fill,
-                  "_ledger": drain_ledger_blocks}
-        with tracer.span("metrics_fetch", drain_round):
-            for m in jax.device_get(commit_metrics):
+                  "_ledger": out["ledger_blocks"]}
+        with tracer.span("metrics_fetch", out["drain_round"]):
+            for m in jax.device_get(out["commit_metrics"]):
                 for key in m:
                     record[key] = record.get(key, 0.0) + float(m[key])
         records.add(record)

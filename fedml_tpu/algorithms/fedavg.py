@@ -458,6 +458,38 @@ class FedAvgAPI(Checkpointable):
             dx, dy, dc, dp = stage_to_device(x, y, counts, participation)
         return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
 
+    def stage_partial_cohort(self, round_idx: int, width: int, cohort: int,
+                             chaos=None, tracer=None) -> StagedCohort:
+        """Partial-cohort staging for buffered serving (the FedBuff
+        follow-up PR 9 deferred): stage only the first `width` clients of
+        round `round_idx`'s seeded `cohort`-sized sample — the replacement
+        slots freed by admitted arrivals — padded back to the static
+        `cohort` width so the client_step signature (and the compile
+        budget) never changes. Padding rows are zero-count no-ops and do
+        NOT appear in `client_idx`, so the buffered runner schedules
+        arrivals only for real rows. With `width == cohort` this is
+        byte-identical to `_stage_cohort` (same sampler, same select, same
+        device commit), which is what makes partial mode degenerate
+        bit-exactly into full dispatch when no stragglers hold capacity."""
+        cfg = self.cfg
+        if tracer is None:
+            tracer = telemetry.get_tracer() or telemetry.NULL_TRACER
+        with tracer.span("stage", round_idx, width=width):
+            sampler = (fast_client_sampling if cfg.fast_sampling
+                       else client_sampling)
+            idx = sampler(round_idx, self.dataset.client_num,
+                          cohort)[:width]
+            faults = (chaos.events(round_idx, len(idx))
+                      if chaos is not None else None)
+            x, y, counts = self.dataset.train.select(idx)
+            if faults is not None:
+                x = apply_faults(faults, x)
+            if counts.shape[0] < cohort:
+                x, y, counts = pad_clients(x, y, counts, cohort)
+        with tracer.span("h2d", round_idx):
+            dx, dy, dc, _ = stage_to_device(x, y, counts, None)
+        return StagedCohort(round_idx, dx, dy, dc, None, faults, idx)
+
     def _train_pipelined(self, start_round, ckpt_dir, ckpt_every,
                          metrics_logger, chaos, guard, tracer,
                          ledger=None) -> None:

@@ -307,7 +307,7 @@ def check_tensor_rule_coverage(rule_tables=None,
 # tracing (not just listing) makes the enumeration crash the moment a
 # signature arm drifts from the real builders.
 DRIVE_CONFIGS = ("eager", "pipelined", "buffered", "tensor", "sharded",
-                 "hierarchical", "silo")
+                 "hierarchical", "silo", "serving")
 
 
 def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
@@ -334,15 +334,45 @@ def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
             "engine.federation_eval[lr,f32]": 2}
 
 
+def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
+                             rng) -> dict:
+    """Abstractly trace the buffered drive's three jit programs (client
+    step, admit, commit) — shared by the buffered and serving enumerations."""
+    from fedml_tpu.algorithms.aggregators import (build_buffer_admit,
+                                                  build_buffer_commit,
+                                                  make_staleness_discount)
+    from fedml_tpu.algorithms.buffered import build_client_step_fn
+
+    programs = {}
+    step = build_client_step_fn(trainer, cfg)
+    result = jax.eval_shape(step, gv, x, y, counts, rng)
+    programs["buffered.client_step[lr,f32]"] = 1
+    k = 5
+    row = lambda l: jax.ShapeDtypeStruct(  # noqa: E731
+        (k,) + l.shape[1:], l.dtype)
+    i32 = lambda s=(): jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    buf = {"vars": jax.tree.map(row, result.variables),
+           "steps": i32((k,)),
+           "weights": jax.ShapeDtypeStruct((k,), jnp.float32),
+           "metrics": {name: row(v)
+                       for name, v in result.metrics.items()},
+           "birth": i32((k,)), "fill": i32()}
+    jax.eval_shape(build_buffer_admit(), buf, result.variables,
+                   result.num_steps, result.metrics, counts,
+                   i32(), i32())
+    programs["buffered.admit[lr,f32]"] = 1
+    jax.eval_shape(build_buffer_commit(agg, make_staleness_discount(0.5)),
+                   gv, agg_state, buf, i32(), rng)
+    programs["buffered.commit[lr,f32,fedavg]"] = 1
+    return programs
+
+
 def enumerate_drive_programs(drive: str) -> dict:
     """{program name: distinct signature count} for one registered drive
     config — the static half of the compile budget. All programs trace on
     the lr/f32/fedavg example (signature COUNT does not depend on the
     model), except silo which needs a conv model to group."""
-    from fedml_tpu.algorithms.aggregators import (build_buffer_admit,
-                                                  build_buffer_commit,
-                                                  make_aggregator,
-                                                  make_staleness_discount)
+    from fedml_tpu.algorithms.aggregators import make_aggregator
     from fedml_tpu.algorithms.engine import build_round_fn
 
     if drive not in DRIVE_CONFIGS:
@@ -367,27 +397,18 @@ def enumerate_drive_programs(drive: str) -> dict:
         jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng, part)
         programs["engine.round[lr,f32,fedavg,masked]"] = 1
     elif drive == "buffered":
-        from fedml_tpu.algorithms.buffered import build_client_step_fn
-        step = build_client_step_fn(trainer, cfg)
-        result = jax.eval_shape(step, gv, x, y, counts, rng)
-        programs["buffered.client_step[lr,f32]"] = 1
-        k = 5
-        row = lambda l: jax.ShapeDtypeStruct(  # noqa: E731
-            (k,) + l.shape[1:], l.dtype)
-        i32 = lambda s=(): jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
-        buf = {"vars": jax.tree.map(row, result.variables),
-               "steps": i32((k,)),
-               "weights": jax.ShapeDtypeStruct((k,), jnp.float32),
-               "metrics": {name: row(v)
-                           for name, v in result.metrics.items()},
-               "birth": i32((k,)), "fill": i32()}
-        jax.eval_shape(build_buffer_admit(), buf, result.variables,
-                       result.num_steps, result.metrics, counts,
-                       i32(), i32())
-        programs["buffered.admit[lr,f32]"] = 1
-        jax.eval_shape(build_buffer_commit(agg, make_staleness_discount(0.5)),
-                       gv, agg_state, buf, i32(), rng)
-        programs["buffered.commit[lr,f32,fedavg]"] = 1
+        programs.update(_trace_buffered_programs(
+            trainer, cfg, agg, gv, agg_state, x, y, counts, rng))
+    elif drive == "serving":
+        # graft-serve multiplexes sync (eager) and buffered tenant jobs
+        # over one mesh: its program set is the UNION of both drives —
+        # each tenant's jit wrappers are its own, but the scheduler's
+        # worst-case static footprint is every program both kinds reach
+        round_fn = build_round_fn(trainer, cfg, agg)
+        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
+        programs["engine.round[lr,f32,fedavg]"] = 1
+        programs.update(_trace_buffered_programs(
+            trainer, cfg, agg, gv, agg_state, x, y, counts, rng))
     elif drive == "tensor":
         from jax.sharding import Mesh
 

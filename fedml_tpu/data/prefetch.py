@@ -63,86 +63,115 @@ class StagedCohort:
     client_idx: np.ndarray
 
 
+#: invalidate()'s default scope: every job's in-flight stagings (the
+#: single-job drive loops' legacy guard-rollback semantics).
+_ALL_JOBS = object()
+
+
 class CohortPrefetcher:
-    """Depth-bounded background stager keyed by round index.
+    """Depth-bounded background stager keyed by (job, round index).
 
     `prefetch(r)` schedules staging of round r if there is capacity;
     `get(r)` returns round r's StagedCohort, staging it on demand on a miss
     (first round, guard retry after `invalidate()`, or depth exhaustion);
     `invalidate()` forgets every in-flight staging. `staged_rounds` /
-    `consumed_rounds` / `misses` expose the schedule to tests."""
+    `consumed_rounds` / `misses` expose the schedule to tests.
 
-    def __init__(self, stage_fn: Callable[[int], StagedCohort], depth: int = 2):
+    Multi-tenant scope (`job=` on prefetch/get/invalidate): the serving
+    scheduler shares ONE prefetcher across tenant jobs, so staged buffers
+    are keyed by `(job, round_idx)` and `invalidate(job=X)` drops only X's
+    in-flight cohorts — one tenant's rollback can never evict another
+    tenant's staged rounds. `job=None` everywhere (the single-job drive
+    loops) reproduces the legacy behavior exactly, including the drop-ALL
+    `invalidate()`. With a job given, the staging callback is called as
+    `stage_fn(round_idx, job)` and runs under `telemetry.job_scope(job)`
+    so stager-thread spans carry the tenant label."""
+
+    def __init__(self, stage_fn: Callable[..., StagedCohort], depth: int = 2):
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self._stage_fn = stage_fn
         self.depth = int(depth)
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="cohort-prefetch")
-        self._inflight: dict[int, Future] = {}
+        # (job, round_idx) -> Future; job is None for single-job drives
+        self._inflight: dict[tuple, Future] = {}
         self._lock = threading.Lock()
         self.staged_rounds: list[int] = []   # every staging that actually ran
         self.consumed_rounds: list[int] = []
         self.misses = 0
         self.invalidations = 0
-        self._staged_at: dict[int, float] = {}  # round -> staging-done time
+        self._staged_at: dict[tuple, float] = {}  # key -> staging-done time
 
-    def _submit(self, round_idx: int) -> Future:
-        def job():
+    def _submit(self, round_idx: int, job=None) -> Future:
+        def work():
             # the append is atomic under the GIL; single worker => ordered
             self.staged_rounds.append(round_idx)
-            staged = self._stage_fn(round_idx)
+            if job is None:
+                staged = self._stage_fn(round_idx)
+            else:
+                with telemetry.job_scope(job):
+                    staged = self._stage_fn(round_idx, job)
             # stager thread vs invalidate()'s clear() on the main thread —
             # the timestamp write must not resurrect an invalidated round
             with self._lock:
-                self._staged_at[round_idx] = time.monotonic()
+                self._staged_at[(job, round_idx)] = time.monotonic()
             return staged
 
-        return self._pool.submit(job)
+        return self._pool.submit(work)
 
-    def prefetch(self, round_idx: int) -> bool:
-        """Schedule round `round_idx` for background staging. No-op (False)
-        when it is already in flight or the pipeline is at depth."""
+    def prefetch(self, round_idx: int, job=None) -> bool:
+        """Schedule round `round_idx` (of `job`, when serving) for
+        background staging. No-op (False) when it is already in flight or
+        the pipeline is at depth."""
+        key = (job, round_idx)
         with self._lock:
-            if round_idx in self._inflight or len(self._inflight) >= self.depth:
+            if key in self._inflight or len(self._inflight) >= self.depth:
                 return False
-            self._inflight[round_idx] = self._submit(round_idx)
+            self._inflight[key] = self._submit(round_idx, job)
             return True
 
-    def get(self, round_idx: int) -> StagedCohort:
+    def get(self, round_idx: int, job=None) -> StagedCohort:
         """Round `round_idx`'s staged cohort; blocks until staged. The
         cohort leaves the prefetcher — its buffers are the caller's to
         donate. A miss stages on demand (same bytes, staging is pure)."""
+        key = (job, round_idx)
         with self._lock:
-            fut = self._inflight.pop(round_idx, None)
+            fut = self._inflight.pop(key, None)
             miss = fut is None
             depth_in_flight = len(self._inflight)
             if miss:
                 self.misses += 1
-                fut = self._submit(round_idx)
+                fut = self._submit(round_idx, job)
         staged = fut.result()
         self.consumed_rounds.append(round_idx)
         # pipeline-occupancy gauge: how deep the pipeline was when this
         # round was consumed and how long its cohort sat staged-ahead
         # (0 on a miss — it was staged on demand just now)
         with self._lock:
-            done_at = self._staged_at.pop(round_idx, None)
+            done_at = self._staged_at.pop(key, None)
         ahead_s = max(0.0, time.monotonic() - done_at) if done_at else 0.0
         telemetry.gauge("prefetch_occupancy", round=round_idx,
                         inflight=depth_in_flight, ahead_s=round(ahead_s, 6),
                         miss=miss)
         return staged
 
-    def invalidate(self) -> None:
-        """Drop every in-flight prefetch (guard rollback): the retried round
+    def invalidate(self, job=_ALL_JOBS) -> None:
+        """Drop in-flight prefetches (guard rollback): the retried round
         re-stages from scratch, and no cohort scheduled before the rollback
-        can be consumed after it."""
+        can be consumed after it. Default scope is EVERY job (the legacy
+        single-job semantics); `invalidate(job=X)` drops only job X's
+        stagings, leaving other tenants' staged cohorts untouched."""
         with self._lock:
-            dropped = len(self._inflight)
-            for fut in self._inflight.values():
-                fut.cancel()  # best-effort; an already-running job just gets dropped
-            self._inflight.clear()
-            self._staged_at.clear()
+            keys = [k for k in self._inflight
+                    if job is _ALL_JOBS or k[0] == job]
+            dropped = len(keys)
+            for k in keys:
+                # best-effort; an already-running job just gets dropped
+                self._inflight.pop(k).cancel()
+                self._staged_at.pop(k, None)
+            if job is _ALL_JOBS:
+                self._staged_at.clear()
         self.invalidations += 1
         telemetry.gauge("prefetch_invalidate", dropped=dropped)
 

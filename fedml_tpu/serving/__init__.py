@@ -1,0 +1,13 @@
+"""graft-serve: the multi-tenant federated serving plane.
+
+One device mesh, N concurrent tenant jobs — different models, algorithms,
+aggregators, buffer configs — multiplexed by a deterministic scheduler.
+A job is declared (`JobDescriptor`), built into a runtime (`Job`) whose
+round program is a schedulable unit, and dispatched by a `Scheduler` whose
+policies (round-robin / deficit-weighted fair share) are seeded and
+bit-reproducible: each tenant's final params are byte-identical to running
+its job solo, no matter how the tenants interleave.
+"""
+
+from fedml_tpu.serving.job import Job, JobDescriptor  # noqa: F401
+from fedml_tpu.serving.scheduler import JobQueue, Scheduler  # noqa: F401

@@ -1,0 +1,299 @@
+"""Declarative federated jobs: a round program as a schedulable unit.
+
+The drive loops (`fedml_tpu/algorithms`) own the whole process — one job,
+one `train()` call to completion. `JobDescriptor` lifts the inputs of such
+a run (model, algorithm, FedConfig, client-store handle, rng seed, round
+budget) into a declarative value, and `Job` wraps the runtime state so ONE
+round is a `step()` call the scheduler can interleave with other tenants.
+
+Bit-reproducibility argument: everything a round consumes is a pure
+function of `(cfg.seed, round_idx)` — sampling, staging, the round rng,
+chaos faults and straggler latencies — and each Job owns its own
+`FedAvgAPI` (params, aggregator state, jit wrappers) plus its own round
+counter. Interleaving tenants therefore cannot perturb any tenant's
+stream: a job stepped under the scheduler trains byte-identical params to
+the same job run solo through `FedAvgAPI.train` (tests/test_serving.py).
+
+Synchronous jobs reuse `FedAvgAPI.train_one_round` verbatim; buffered jobs
+(`cfg.buffer_size > 0`) reuse `algorithms.buffered.BufferedRunner` — the
+same step/drain code path as the classic buffered loop — optionally in
+`partial_dispatch` mode, where each dispatch round stages only as many
+replacement clients as arrivals have freed buffer capacity
+(`FedAvgAPI.stage_partial_cohort`) instead of re-running the full cohort.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.buffered import BufferedRunner
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.robustness.chaos import summarize as chaos_summary
+from fedml_tpu.telemetry.records import RoundRecordLog
+
+
+@dataclass(frozen=True)
+class JobDescriptor:
+    """Everything needed to (re)build one tenant's federated run.
+
+    `weight` feeds the scheduler's deficit-weighted fair-share policy;
+    `partial_dispatch` opts a buffered job into replacement-client
+    dispatch. `trainer_factory` defaults to the standard classification
+    trainer over `create_model(cfg.model, output_dim=dataset.class_num)`.
+    """
+
+    name: str
+    config: FedConfig
+    dataset: Any  # data.registry.FederatedDataset (any backing store)
+    aggregator_name: str = "fedavg"
+    trainer_factory: Optional[Callable[[], Any]] = None
+    chaos: Any = None  # robustness.chaos.FaultPlan
+    weight: float = 1.0
+    partial_dispatch: bool = False
+    extra: dict = field(default_factory=dict, hash=False, compare=False)
+
+    @property
+    def kind(self) -> str:
+        return "buffered" if self.config.buffer_size > 0 else "sync"
+
+    @property
+    def drive(self) -> str:
+        """Which COMPILE_BUDGET.json drive this tenant's jit programs are
+        accounted against (per-tenant compile-budget gate)."""
+        return "buffered" if self.config.buffer_size > 0 else "eager"
+
+    @property
+    def rounds(self) -> int:
+        return int(self.config.comm_round)
+
+    def build_trainer(self):
+        if self.trainer_factory is not None:
+            return self.trainer_factory()
+        from fedml_tpu.core.trainer import ClassificationTrainer
+        from fedml_tpu.models.registry import create_model
+
+        return ClassificationTrainer(
+            create_model(self.config.model,
+                         output_dim=self.dataset.class_num))
+
+    def build_api(self) -> FedAvgAPI:
+        """A fresh FedAvgAPI for this descriptor — the SAME construction a
+        solo `train()` run uses, so served and solo runs share programs."""
+        return FedAvgAPI(self.dataset, self.config, self.build_trainer(),
+                         aggregator_name=self.aggregator_name)
+
+    def build(self) -> "Job":
+        return Job(self)
+
+
+class Job:
+    """One tenant's runtime: pending -> running -> committed.
+
+    `step(tracer)` executes exactly one dispatch round (buffered jobs also
+    drain after their final round) and returns True once the job has
+    consumed its whole round budget. The scheduler owns WHEN steps happen;
+    the job owns WHAT a step does — and what it does is independent of the
+    interleaving by construction (see module docstring)."""
+
+    def __init__(self, desc: JobDescriptor):
+        self.desc = desc
+        self.name = desc.name
+        self.api = desc.build_api()
+        self.round_idx = 0
+        self.state = "pending"
+        self.records: Optional[RoundRecordLog] = None
+        self.runner: Optional[BufferedRunner] = None
+        if desc.kind == "buffered":
+            self.runner = BufferedRunner(
+                self.api, chaos=desc.chaos,
+                partial_dispatch=desc.partial_dispatch)
+        # scheduler bookkeeping (deficit-weighted fair share + bench timing)
+        self.deficit = 0.0
+        self.dispatched_ticks = 0
+        self.submit_t: Optional[float] = None
+        self.start_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        # one-shot staged-cohort handoff from the scheduler's shared
+        # prefetcher into the api's stage seam (sync path)
+        self._staged_override = None
+        self._orig_stage_fn = self.api.stage_fn
+        self.api.stage_fn = self._stage_or_override
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def done(self) -> bool:
+        return self.state == "committed"
+
+    @property
+    def history(self):
+        return self.api.history
+
+    @property
+    def prefetchable(self) -> bool:
+        """Whether this job's cohorts can be staged ahead by round index:
+        staging must be pure in round_idx, which partial dispatch is not
+        (its width depends on in-flight capacity at dispatch time)."""
+        return not (self.desc.kind == "buffered"
+                    and self.desc.partial_dispatch)
+
+    def _stage_or_override(self, round_idx, **kw):
+        staged = self._staged_override
+        if staged is not None and staged.round_idx == round_idx:
+            self._staged_override = None
+            return staged
+        return self._orig_stage_fn(round_idx, **kw)
+
+    def stage(self, round_idx: int):
+        """Stage one cohort for this job — the shared prefetcher's staging
+        callback (pure in round_idx; chaos faults derived per round)."""
+        return self._orig_stage_fn(round_idx, chaos=self.desc.chaos)
+
+    # ----------------------------------------------------------------- step
+    def step(self, tracer, staged=None) -> bool:
+        """One schedulable unit of this job. `staged` (optional) is a
+        prefetched cohort for `self.round_idx`. Returns True when the job
+        just finished (drain included)."""
+        if self.done:
+            return True
+        if self.state == "pending":
+            self.state = "running"
+            self.records = RoundRecordLog(tracer, self.api.history, None)
+        if self.desc.kind == "sync":
+            self._step_sync(tracer, staged)
+        else:
+            self._step_buffered(tracer, staged)
+        if self.round_idx >= self.desc.rounds:
+            self.state = "committed"
+        return self.done
+
+    def _step_sync(self, tracer, staged) -> None:
+        cfg = self.api.cfg
+        r = self.round_idx
+        with tracer.round(r) as rspan:
+            faults = None
+            if self.desc.chaos is not None and staged is None:
+                n_cohort = min(cfg.client_num_per_round,
+                               self.api.dataset.client_num)
+                faults = self.desc.chaos.events(r, n_cohort)
+            self._staged_override = staged
+            train_metrics = self.api.train_one_round(r, faults=faults,
+                                                     tracer=tracer)
+            with tracer.span("device_wait", r):
+                jax.block_until_ready(self.api.global_variables)
+            record = {"round": r, "round_time": rspan.elapsed()}
+            staged_used, stats = self.api._last_dispatch
+            block = FedAvgAPI._ledger_block(r, staged_used, stats)
+            if block is not None:
+                record["_ledger"] = [block]
+            if staged_used.faults is not None:
+                record.update(chaos_summary(staged_used.faults))
+                for k in ("participated_count", "quarantined_count"):
+                    if k in train_metrics:
+                        record[k] = train_metrics[k]
+            if (r % cfg.frequency_of_the_test == 0
+                    or r == cfg.comm_round - 1):
+                with tracer.span("eval", r):
+                    record.update(self.api.local_test_on_all_clients(r))
+                    record.update(self.api.test_global(r))
+            self.records.add(record)
+            self.records.flush(r)
+        self.round_idx += 1
+
+    def _step_buffered(self, tracer, staged) -> None:
+        cfg = self.api.cfg
+        runner = self.runner
+        host = runner.host
+        r = self.round_idx
+        with tracer.round(r) as rspan:
+            if staged is None:
+                staged = self._stage_buffered(r, tracer)
+            rng_round = runner.base_rng(r)
+            out = runner.step(r, staged, rng_round, tracer)
+            train_metrics: dict = {}
+            if out["commit_metrics"]:
+                with tracer.span("metrics_fetch", r):
+                    for m in jax.device_get(out["commit_metrics"]):
+                        for key in m:
+                            train_metrics[key] = (
+                                train_metrics.get(key, 0.0) + float(m[key]))
+            record = {"round": r, "round_time": rspan.elapsed(),
+                      "buffer_commits": out["n_commits"],
+                      "committed_updates": host.committed_updates,
+                      "buffer_fill": host.fill,
+                      "_ledger": out["ledger_blocks"]}
+            for key in ("loss_sum", "total", "participated_count",
+                        "quarantined_count", "staleness_sum",
+                        "staleness_max"):
+                if key in train_metrics:
+                    record[key] = train_metrics[key]
+            if staged is not None and staged.faults is not None:
+                record.update(chaos_summary(staged.faults))
+            if (r % cfg.frequency_of_the_test == 0
+                    or r == cfg.comm_round - 1):
+                with tracer.span("eval", r):
+                    record.update(self.api.local_test_on_all_clients(r))
+                    record.update(self.api.test_global(r))
+            self.records.add(record)
+            self.records.flush(r)
+        self.round_idx += 1
+        if self.round_idx >= cfg.comm_round:
+            self._drain_buffered(tracer)
+
+    def _stage_buffered(self, round_idx: int, tracer):
+        """Stage this dispatch round's cohort — the full seeded sample in
+        classic mode, the freed-capacity prefix (padded to static width)
+        in partial mode, or None when there is no capacity at all (the
+        dispatch program is skipped; the round only processes arrivals)."""
+        cfg = self.api.cfg
+        cohort = min(cfg.client_num_per_round, self.api.dataset.client_num)
+        width = self.runner.capacity(cohort)
+        if width <= 0:
+            return None
+        if width >= cohort:
+            return self.api.stage_fn(round_idx, chaos=self.desc.chaos,
+                                     tracer=tracer)
+        return self.api.stage_partial_cohort(round_idx, width, cohort,
+                                             chaos=self.desc.chaos,
+                                             tracer=tracer)
+
+    def _drain_buffered(self, tracer) -> None:
+        out = self.runner.drain(tracer)
+        if not out["n_commits"]:
+            return
+        host = self.runner.host
+        cfg = self.api.cfg
+        record = {"round": cfg.comm_round, "round_time": 0.0,
+                  "buffer_commits": out["n_commits"],
+                  "committed_updates": host.committed_updates,
+                  "buffer_fill": host.fill,
+                  "_ledger": out["ledger_blocks"]}
+        with tracer.span("metrics_fetch", out["drain_round"]):
+            for m in jax.device_get(out["commit_metrics"]):
+                for key in m:
+                    record[key] = record.get(key, 0.0) + float(m[key])
+        self.records.add(record)
+        self.records.flush(cfg.comm_round)
+
+    def final_params(self):
+        """Host copy of the final global variables (bitwise-comparable)."""
+        return jax.device_get(self.api.global_variables)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug nicety
+        return (f"Job({self.name!r}, kind={self.desc.kind}, "
+                f"round={self.round_idx}/{self.desc.rounds}, "
+                f"state={self.state})")
+
+
+def params_equal(a, b) -> bool:
+    """Bitwise equality over two fetched variable pytrees."""
+    la = jax.tree.leaves(a)
+    lb = jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))

@@ -1,0 +1,281 @@
+"""graft-serve scheduler: deterministic multi-tenant dispatch over one mesh.
+
+`JobQueue` holds tenant jobs in submission order; `Scheduler` owns WHICH
+job steps next. Two policies, both seeded by nothing but submission order
+and tick count — no wall clock, no thread races — so a schedule is
+bit-reproducible across reruns:
+
+- ``round_robin``: cycle submission order, skipping finished jobs.
+- ``fair_share``: deficit round-robin. Every tick each active job accrues
+  its `weight`; the max-deficit job (submission order breaks ties) runs
+  and pays the total active weight. A weight-2 tenant gets 2 of every 3
+  ticks next to a weight-1 tenant, deterministically.
+
+Per-tenant compile accounting: around every step (and descriptor build)
+the scheduler snapshots the tracer's `compile_cache` event ledger and
+attributes the delta (requests / cache hits / cache misses) to the tenant
+that ran. `check_compile_budgets()` gates each tenant's compile requests
+against its drive's `max_compiles` ceiling in COMPILE_BUDGET.json — one
+tenant blowing the jit cache fails ITS budget, not its neighbors'.
+
+Cross-tenant prefetch: one shared `CohortPrefetcher` stages cohorts ahead
+for jobs that want it (`cfg.pipeline_depth > 0` and round-pure staging),
+keyed by `(job, round_idx)` so one tenant's rollback/commit can never
+evict another tenant's staged rounds (data/prefetch.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Union
+
+from fedml_tpu import telemetry
+from fedml_tpu.data.prefetch import CohortPrefetcher
+from fedml_tpu.serving.job import Job, JobDescriptor
+
+#: compile_cache event-name tails -> ledger keys (utils/cache.py forwards
+#: jax.monitoring events whose full names end in these segments)
+_COMPILE_TAILS = {
+    "compile_requests_use_cache": "requests",
+    "cache_hits": "cache_hits",
+    "cache_misses": "cache_misses",
+}
+
+
+def _zero_counts() -> Dict[str, int]:
+    return {"requests": 0, "cache_hits": 0, "cache_misses": 0}
+
+
+def load_compile_budgets(path: Optional[str] = None) -> dict:
+    """COMPILE_BUDGET.json as a dict (drive -> budget entry)."""
+    if path is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(repo_root, "COMPILE_BUDGET.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+class JobQueue:
+    """Submission-ordered tenant jobs, addressable by unique name."""
+
+    def __init__(self):
+        self._jobs: List[Job] = []
+        self._by_name: Dict[str, Job] = {}
+
+    def submit(self, job: Job) -> Job:
+        if job.name in self._by_name:
+            raise ValueError(f"duplicate job name {job.name!r}")
+        self._jobs.append(job)
+        self._by_name[job.name] = job
+        return job
+
+    def get(self, name: str) -> Job:
+        return self._by_name[name]
+
+    def active(self) -> List[Job]:
+        return [j for j in self._jobs if not j.done]
+
+    def all_done(self) -> bool:
+        return all(j.done for j in self._jobs)
+
+    def __iter__(self):
+        return iter(self._jobs)
+
+    def __len__(self) -> int:
+        return len(self._jobs)
+
+    def __getitem__(self, i: int) -> Job:
+        return self._jobs[i]
+
+
+class Scheduler:
+    """Dispatch loop over a JobQueue. `tick()` steps exactly one job (the
+    policy's pick) under its `telemetry.job_scope`; `run()` ticks until the
+    queue drains. `prefetch_depth` bounds staged-ahead cohorts across ALL
+    tenants (0 disables the shared prefetcher)."""
+
+    POLICIES = ("round_robin", "fair_share")
+
+    def __init__(self, policy: str = "round_robin", tracer=None,
+                 budgets: Optional[dict] = None, prefetch_depth: int = 4):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; choose from {self.POLICIES}")
+        self.policy = policy
+        self.tracer = tracer if tracer is not None else telemetry.NULL_TRACER
+        self.budgets = budgets
+        self.queue = JobQueue()
+        self.compile_ledger: Dict[str, Dict[str, int]] = {}
+        self.ticks = 0
+        self._rr_cursor = 0
+        self._prefetch_depth = int(prefetch_depth)
+        self._prefetcher: Optional[CohortPrefetcher] = None
+
+    # ------------------------------------------------------------- submit
+    def submit(self, job: Union[Job, JobDescriptor],
+               submit_t: Optional[float] = None) -> Job:
+        """Enqueue a tenant. A descriptor is built here, under the
+        tenant's job scope, so its construction compiles (model init) land
+        in the tenant's compile ledger."""
+        if isinstance(job, JobDescriptor):
+            before = self._compile_counts()
+            with telemetry.job_scope(job.name):
+                job = job.build()
+            self._account(job, before)
+        else:
+            self.compile_ledger.setdefault(job.name, _zero_counts())
+        job.submit_t = submit_t if submit_t is not None else self.tracer.now()
+        return self.queue.submit(job)
+
+    # ------------------------------------------------------------ policies
+    def _pick(self) -> Optional[Job]:
+        active = self.queue.active()
+        if not active:
+            return None
+        if self.policy == "round_robin":
+            n = len(self.queue)
+            for _ in range(n):
+                job = self.queue[self._rr_cursor % n]
+                self._rr_cursor += 1
+                if not job.done:
+                    return job
+            return None
+        # fair_share: deficit round-robin over the active set
+        total = 0.0
+        for job in active:
+            job.deficit += job.desc.weight
+            total += job.desc.weight
+        picked = active[0]
+        for job in active[1:]:
+            if job.deficit > picked.deficit:
+                picked = job
+        picked.deficit -= total
+        return picked
+
+    # ---------------------------------------------------------------- tick
+    def tick(self) -> Optional[str]:
+        """Step the policy's pick one round. Returns the stepped job's
+        name, or None when every job has committed."""
+        job = self._pick()
+        if job is None:
+            return None
+        self.ticks += 1
+        job.dispatched_ticks += 1
+        if job.start_t is None:
+            job.start_t = self.tracer.now()
+        before = self._compile_counts()
+        with telemetry.job_scope(job.name):
+            staged = self._take_prefetched(job)
+            done = job.step(self.tracer, staged=staged)
+        self._account(job, before)
+        if done:
+            job.finish_t = self.tracer.now()
+            wall = job.finish_t - (job.start_t or job.finish_t)
+            self.tracer.event("job_committed", job=job.name,
+                              rounds=job.round_idx, wall_s=round(wall, 6))
+            if self._prefetcher is not None:
+                self._prefetcher.invalidate(job=job.name)
+        else:
+            self._prefetch_ahead(job)
+        return job.name
+
+    def run(self) -> int:
+        """Tick until the queue drains; returns the tick count. Installs
+        the tracer for the duration so module-level telemetry (chaos,
+        prefetch gauges, compile-cache events) lands in it."""
+        install = hasattr(self.tracer, "find_events")
+        if install:
+            telemetry.install(self.tracer)
+        try:
+            while self.tick() is not None:
+                pass
+        finally:
+            if install:
+                telemetry.uninstall(self.tracer)
+            self.close()
+        return self.ticks
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+
+    # ------------------------------------------------------- prefetch seam
+    def _wants_prefetch(self, job: Job) -> bool:
+        return (self._prefetch_depth > 0 and job.prefetchable
+                and job.api.cfg.pipeline_depth > 0)
+
+    def _ensure_prefetcher(self) -> CohortPrefetcher:
+        if self._prefetcher is None:
+            self._prefetcher = CohortPrefetcher(
+                lambda r, jobname: self.queue.get(jobname).stage(r),
+                depth=self._prefetch_depth)
+        return self._prefetcher
+
+    def _take_prefetched(self, job: Job):
+        if not self._wants_prefetch(job):
+            return None
+        return self._ensure_prefetcher().get(job.round_idx, job=job.name)
+
+    def _prefetch_ahead(self, job: Job) -> None:
+        if not self._wants_prefetch(job):
+            return
+        pf = self._ensure_prefetcher()
+        for k in range(job.api.cfg.pipeline_depth):
+            r = job.round_idx + k
+            if r >= job.desc.rounds:
+                break
+            pf.prefetch(r, job=job.name)
+
+    # --------------------------------------------------- compile accounting
+    def _compile_counts(self) -> Optional[Dict[str, int]]:
+        """Fold the tracer's compile_cache event ledger into cumulative
+        {requests, cache_hits, cache_misses}; None when the tracer keeps no
+        event ledger (NullTracer)."""
+        if not hasattr(self.tracer, "find_events"):
+            return None
+        totals = _zero_counts()
+        for e in self.tracer.find_events("compile_cache"):
+            key = _COMPILE_TAILS.get(str(e.get("name", "")).rsplit("/", 1)[-1])
+            if key is not None:
+                totals[key] += 1
+        return totals
+
+    def _account(self, job: Job, before: Optional[Dict[str, int]]) -> None:
+        ledger = self.compile_ledger.setdefault(job.name, _zero_counts())
+        if before is None:
+            return
+        after = self._compile_counts()
+        for key in ledger:
+            ledger[key] += after[key] - before[key]
+
+    def check_compile_budgets(self, budgets: Optional[dict] = None):
+        """Gate every tenant's compile requests against its drive's
+        `max_compiles` ceiling in COMPILE_BUDGET.json. Returns
+        (ok, report) — ok is False if ANY tenant exceeded its ceiling;
+        tenants whose drive pins no ceiling are SKIP lines."""
+        if budgets is None:
+            budgets = self.budgets if self.budgets is not None \
+                else load_compile_budgets()
+        lines = []
+        ok = True
+        for job in self.queue:
+            counts = self.compile_ledger.get(job.name, _zero_counts())
+            drive = job.desc.drive
+            ceiling = (budgets.get(drive) or {}).get("max_compiles")
+            if ceiling is None:
+                lines.append(f"SKIP tenant={job.name} drive={drive} "
+                             f"requests={counts['requests']} "
+                             f"(no ceiling pinned)")
+                continue
+            verdict = "OK" if counts["requests"] <= ceiling else "FAIL"
+            if verdict == "FAIL":
+                ok = False
+            lines.append(
+                f"{verdict} tenant={job.name} drive={drive} "
+                f"requests={counts['requests']} <= max {ceiling} "
+                f"(hits={counts['cache_hits']} "
+                f"misses={counts['cache_misses']})")
+        return ok, "\n".join(lines)

@@ -11,10 +11,12 @@ from fedml_tpu.telemetry.tracer import (  # noqa: F401
     NULL_TRACER,
     NullTracer,
     Tracer,
+    current_job,
     emit,
     gauge,
     get_tracer,
     install,
+    job_scope,
     parse_profile_rounds,
     uninstall,
 )

@@ -80,7 +80,37 @@ EVENT_SCHEMAS: Dict[str, set] = {
     # client-health fleet report (tools/client_report.py): one per flagged
     # client — quarantine recidivist or update-norm z-score outlier
     "client_flagged": {"client", "reason", "value"},
+    # serving plane (serving/scheduler.py): a tenant job ran its full round
+    # budget (drain included) and left the queue
+    "job_committed": {"job", "rounds", "wall_s"},
 }
+
+
+# --------------------------------------------------------- job labeling
+# The serving plane multiplexes N tenant jobs through ONE tracer; every
+# record written while a job_scope is active carries a "job" field so
+# TRACE.jsonl lines and --trace_summary can be split per tenant. Thread-
+# local on purpose: the prefetcher's staging thread enters its own scope
+# for the job it is staging, independent of what the scheduler thread is
+# dispatching.
+_JOB_CTX = threading.local()
+
+
+def current_job() -> Optional[str]:
+    """The active job label on THIS thread, or None outside any scope."""
+    return getattr(_JOB_CTX, "label", None)
+
+
+@contextmanager
+def job_scope(label: Optional[str]):
+    """Tag every span/event/gauge recorded on this thread with `label`.
+    Nests (innermost wins, restored on exit); `label=None` clears."""
+    prev = getattr(_JOB_CTX, "label", None)
+    _JOB_CTX.label = label
+    try:
+        yield
+    finally:
+        _JOB_CTX.label = prev
 
 
 def _thread_label() -> str:
@@ -200,6 +230,9 @@ class Tracer:
             dur = self.now() - t0
             rec = {"type": "span", "name": name, "round": round_idx,
                    "thread": _thread_label(), "t0": t0, "dur_s": dur}
+            job = current_job()
+            if job is not None:
+                rec["job"] = job
             if attrs:
                 rec.update(attrs)
             with self._lock:
@@ -262,6 +295,9 @@ class Tracer:
                 f"event {kind!r} missing required field(s) {sorted(missing)}")
         rec = {"type": "event", "kind": kind, "t": self.now(),
                "thread": _thread_label(), **fields}
+        job = current_job()
+        if job is not None and "job" not in rec:
+            rec["job"] = job
         with self._lock:
             self.events.append(rec)
         self._write(rec)
@@ -271,6 +307,9 @@ class Tracer:
         no cross-mode equality contract."""
         rec = {"type": "gauge", "name": name, "t": self.now(),
                "thread": _thread_label(), **fields}
+        job = current_job()
+        if job is not None and "job" not in rec:
+            rec["job"] = job
         with self._lock:
             self.gauges.append(rec)
         self._write(rec)
@@ -328,9 +367,27 @@ class Tracer:
                     st["total"][k] = st["total"].get(k, 0) + v
         return out
 
+    def job_summary(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """Per-job per-phase {count, total_s} over spans carrying a `job`
+        label (serving tenants); {} when no labeled spans were recorded."""
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        with self._lock:
+            spans = list(self.spans)
+        for s in spans:
+            job = s.get("job")
+            if job is None:
+                continue
+            st = out.setdefault(job, {}).setdefault(
+                s["name"], {"count": 0, "total_s": 0.0})
+            st["count"] += 1
+            st["total_s"] += s["dur_s"]
+        return out
+
     def summary_table(self) -> str:
         """The --trace_summary human table: per-phase span percentiles,
-        then a gauges section (count + folded totals + last payload)."""
+        then a gauges section (count + folded totals + last payload),
+        then — when serving-plane job labels are present — a per-tenant
+        phase breakdown."""
         rows = [f"{'phase':<16} {'count':>6} {'total_s':>10} "
                 f"{'p50_ms':>9} {'p95_ms':>9}"]
         for name, st in self.summary().items():
@@ -346,6 +403,15 @@ class Tracer:
                                 if k not in st["total"])
                 detail = "  ".join(p for p in (totals, last) if p)
                 rows.append(f"{name:<24} {st['count']:>6d}  {detail}")
+        jobs = self.job_summary()
+        if jobs:
+            rows.append("")
+            rows.append(f"{'job':<20} {'phase':<16} {'count':>6} "
+                        f"{'total_s':>10}")
+            for job, phases in sorted(jobs.items()):
+                for name, st in sorted(phases.items()):
+                    rows.append(f"{job:<20} {name:<16} {st['count']:>6d} "
+                                f"{st['total_s']:>10.4f}")
         return "\n".join(rows)
 
     # ---------------------------------------------------------------- close

@@ -1,0 +1,335 @@
+"""graft-serve (ISSUE 12 tentpole): the multi-tenant job scheduler over one
+device mesh.
+
+The pins that matter:
+  - a two-tenant scheduler run is byte-identical across reruns (schedule
+    AND final params) — dispatch is seeded by submission order + tick
+    count, nothing else;
+  - each tenant's final params are bitwise-equal to running its job SOLO
+    through the classic `FedAvgAPI.train` drive — interleaving tenants
+    perturbs no tenant's stream;
+  - deficit-weighted fair share bounds per-tenant dispatch skew at the
+    weight ratio, deterministically;
+  - the shared prefetcher scopes staged buffers by job id — one tenant's
+    invalidate can never evict another tenant's staged cohorts (the PR 12
+    isolation regression);
+  - partial-cohort dispatch degenerates to full dispatch bit-exactly when
+    nobody straggles, and stages only freed capacity when clients do;
+  - tenant N+1 with the same model config warm-starts from the persistent
+    compile cache (cache_hits > 0 in its scheduler ledger), and a tenant
+    exceeding its drive's pinned max_compiles ceiling FAILs the budget
+    gate.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.experimental.compilation_cache import compilation_cache as cc
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.prefetch import CohortPrefetcher
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.serving import JobDescriptor, JobQueue, Scheduler
+from fedml_tpu.serving.job import params_equal
+from fedml_tpu.telemetry.tracer import Tracer
+from fedml_tpu.utils.cache import enable_compile_cache
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+@pytest.fixture(scope="module")
+def ds16():
+    return load_dataset("mnist", client_num_in_total=16,
+                        partition_method="homo", seed=1)
+
+
+def _cfg(ds, **kw):
+    kw.setdefault("client_num_per_round", ds.client_num)
+    kw.setdefault("comm_round", 3)
+    kw.setdefault("seed", 0)
+    kw.setdefault("lr", 0.05)
+    return FedConfig(dataset="mnist", model="lr", batch_size=8,
+                     client_num_in_total=ds.client_num, **kw)
+
+
+def _desc(name, ds, weight=1.0, chaos=None, partial=False, **cfg_kw):
+    return JobDescriptor(name=name, config=_cfg(ds, **cfg_kw), dataset=ds,
+                         weight=weight, chaos=chaos, partial_dispatch=partial)
+
+
+def _solo(ds, cfg):
+    api = FedAvgAPI(ds, cfg, ClassificationTrainer(
+        create_model("lr", output_dim=ds.class_num)))
+    api.train()
+    return api
+
+
+def _run_two_tenants(ds, policy="round_robin"):
+    tracer = Tracer()
+    sched = Scheduler(policy=policy, tracer=tracer)
+    sched.submit(_desc("tenant-a", ds, seed=0))
+    sched.submit(_desc("tenant-b", ds, seed=1, lr=0.03, buffer_size=5,
+                       staleness_alpha=0.5))
+    order = []
+    while True:
+        name = sched.tick()
+        if name is None:
+            break
+        order.append(name)
+    sched.close()
+    return sched, tracer, order
+
+
+# ------------------------------------------------ determinism + solo parity
+
+def test_two_tenant_run_byte_identical_across_reruns(ds8):
+    s1, t1, order1 = _run_two_tenants(ds8)
+    s2, _, order2 = _run_two_tenants(ds8)
+    assert order1 == order2
+    for name in ("tenant-a", "tenant-b"):
+        assert params_equal(s1.queue.get(name).final_params(),
+                            s2.queue.get(name).final_params()), name
+    # both tenants committed, each with a job_committed ledger event
+    committed = {e["job"]: e for e in t1.find_events("job_committed")}
+    assert set(committed) == {"tenant-a", "tenant-b"}
+    assert all(e["rounds"] == 3 for e in committed.values())
+    # every tenant's round spans carry its job label
+    jobs = t1.job_summary()
+    assert set(jobs) == {"tenant-a", "tenant-b"}
+    assert all(phases["round"]["count"] == 3 for phases in jobs.values())
+
+
+def test_tenant_final_params_bitwise_equal_solo_run(ds8):
+    """The acceptance pin: interleaved tenants train the SAME bytes as
+    solo runs — for the sync tenant and the buffered tenant both."""
+    sched, _, _ = _run_two_tenants(ds8)
+    solo_a = _solo(ds8, _cfg(ds8, seed=0))
+    solo_b = _solo(ds8, _cfg(ds8, seed=1, lr=0.03, buffer_size=5,
+                             staleness_alpha=0.5))
+    assert params_equal(sched.queue.get("tenant-a").final_params(),
+                        jax.device_get(solo_a.global_variables))
+    assert params_equal(sched.queue.get("tenant-b").final_params(),
+                        jax.device_get(solo_b.global_variables))
+    # histories line up round for round (buffered adds its drain record)
+    assert len(sched.queue.get("tenant-a").history) == len(solo_a.history)
+    assert len(sched.queue.get("tenant-b").history) == len(solo_b.history)
+
+
+# ------------------------------------------------------- fair-share policy
+
+def test_fair_share_bounds_dispatch_skew(ds8):
+    """Weight 2:1 -> the heavy tenant gets 2 of every 3 ticks while both
+    are active, off by at most one in any prefix (deficit round-robin's
+    bounded-lag property), and the schedule reproduces exactly."""
+    def run():
+        sched = Scheduler(policy="fair_share", tracer=Tracer())
+        sched.submit(_desc("heavy", ds8, weight=2.0, seed=0, comm_round=8))
+        sched.submit(_desc("light", ds8, weight=1.0, seed=1, comm_round=4))
+        order = []
+        while True:
+            name = sched.tick()
+            if name is None:
+                break
+            order.append(name)
+        sched.close()
+        return order
+
+    order = run()
+    assert order == run()  # bit-reproducible schedule
+    # while both tenants are active (light's 4 rounds = first 12 ticks at
+    # a 2:1 split), every prefix stays within one dispatch of the ratio
+    both_active = order[:order.index("light") + order.count("light")]
+    for i in range(1, 12 + 1):
+        heavy = order[:i].count("heavy")
+        assert abs(heavy - 2 * i / 3) <= 1.0, (i, order)
+    assert order.count("heavy") == 8 and order.count("light") == 4
+    del both_active
+
+
+def test_scheduler_validation(ds8):
+    with pytest.raises(ValueError, match="policy"):
+        Scheduler(policy="lottery")
+    q = JobQueue()
+    q.submit(_desc("dup", ds8).build())
+    with pytest.raises(ValueError, match="duplicate"):
+        q.submit(_desc("dup", ds8).build())
+
+
+# ------------------------------------------- prefetcher per-job isolation
+
+def test_prefetcher_scopes_staged_buffers_by_job():
+    """The PR 12 isolation regression: invalidate(job=A) must drop only
+    A's in-flight stagings; B's staged cohorts stay warm. The legacy
+    argless invalidate() still drops everything (single-job drives)."""
+    staged_calls = []
+
+    def stage(round_idx, job):
+        staged_calls.append((job, round_idx))
+        return (job, round_idx)
+
+    with CohortPrefetcher(stage, depth=4) as pf:
+        assert pf.prefetch(0, job="A") and pf.prefetch(1, job="A")
+        assert pf.prefetch(0, job="B") and pf.prefetch(1, job="B")
+        pf.invalidate(job="A")
+        # B's rounds are still staged: consuming them is NOT a miss
+        assert pf.get(0, job="B") == ("B", 0)
+        assert pf.get(1, job="B") == ("B", 1)
+        assert pf.misses == 0
+        # A's were dropped: consuming re-stages on demand
+        assert pf.get(0, job="A") == ("A", 0)
+        assert pf.misses == 1
+        # legacy drop-all still works
+        pf.prefetch(5, job="A")
+        pf.prefetch(5, job="B")
+        pf.invalidate()
+        assert pf.get(5, job="B") == ("B", 5)
+        assert pf.misses == 2
+
+
+def test_interleaved_pipelined_jobs_stay_isolated(ds8):
+    """Two interleaved jobs with prefetch enabled: per-job staging keys
+    mean each tenant still consumes ITS round-r cohort, so both stay
+    bitwise-equal to their solo runs, and the first tenant's completion
+    (which invalidates its job scope) cannot disturb the second."""
+    tracer = Tracer()
+    sched = Scheduler(policy="round_robin", tracer=tracer, prefetch_depth=4)
+    sched.submit(_desc("pipe-a", ds8, seed=0, pipeline_depth=2, comm_round=2))
+    sched.submit(_desc("pipe-b", ds8, seed=1, pipeline_depth=2, comm_round=5,
+                       lr=0.02))
+    sched.run()
+    solo_a = _solo(ds8, _cfg(ds8, seed=0, pipeline_depth=2, comm_round=2))
+    solo_b = _solo(ds8, _cfg(ds8, seed=1, pipeline_depth=2, comm_round=5,
+                             lr=0.02))
+    assert params_equal(sched.queue.get("pipe-a").final_params(),
+                        jax.device_get(solo_a.global_variables))
+    assert params_equal(sched.queue.get("pipe-b").final_params(),
+                        jax.device_get(solo_b.global_variables))
+
+
+# ----------------------------------------------- partial-cohort dispatch
+
+def test_partial_dispatch_degenerates_to_full_without_stragglers(ds16):
+    """No stragglers -> every arrival lands the round it was dispatched,
+    capacity is always the full cohort, and partial mode is bit-identical
+    to classic full-cohort dispatch."""
+    def run(partial):
+        sched = Scheduler(tracer=Tracer())
+        sched.submit(_desc("t", ds16, seed=0, comm_round=4, buffer_size=5,
+                           staleness_alpha=0.5, client_num_per_round=8,
+                           partial=partial))
+        sched.run()
+        return sched.queue.get("t")
+
+    assert params_equal(run(False).final_params(), run(True).final_params())
+
+
+def test_partial_dispatch_stages_only_freed_capacity(ds16):
+    """With stragglers holding updates in flight, partial mode stages
+    narrower replacement cohorts (width < cohort) instead of re-running
+    the full cohort every dispatch round — and still converges finitely."""
+    plan = FaultPlan(seed=3, straggler_rate=0.5, straggler_rounds=3)
+
+    def run(partial):
+        tracer = Tracer()
+        sched = Scheduler(tracer=tracer)
+        sched.submit(_desc("t", ds16, seed=0, comm_round=5, buffer_size=5,
+                           staleness_alpha=0.5, client_num_per_round=8,
+                           chaos=plan, partial=partial))
+        sched.run()
+        return sched.queue.get("t"), tracer
+
+    job_p, tr_p = run(True)
+    job_f, _ = run(False)
+    widths = [s["width"] for s in tr_p.find_spans("stage") if "width" in s]
+    assert widths and all(w < 8 for w in widths)  # replacement cohorts only
+    # partial mode dispatched strictly fewer client-steps overall
+    assert (job_p.runner.host.committed_updates
+            < job_f.runner.host.committed_updates)
+    assert all(bool(np.isfinite(np.asarray(l)).all())
+               for l in jax.tree.leaves(job_p.final_params()))
+
+
+# ------------------------------------------------ compile budget + warm start
+
+@pytest.fixture
+def restore_jax_cache_config():
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cc.reset_cache()
+    yield
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+    cc.reset_cache()
+
+
+def test_second_tenant_warm_starts_from_compile_cache(
+        tmp_path, ds8, restore_jax_cache_config):
+    """Tenant N+1 with the same model config must not pay cold compiles:
+    its jit wrappers are its own, but XLA serves them from the persistent
+    cache — the scheduler's per-tenant ledger shows cache hits for the
+    second tenant."""
+    assert enable_compile_cache(min_compile_secs=0.0,
+                                cache_dir=str(tmp_path / "jcache"))
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer)
+    sched.submit(_desc("first", ds8, seed=0, comm_round=2))
+    sched.submit(_desc("second", ds8, seed=1, comm_round=2))
+    sched.run()
+    ledger = sched.compile_ledger
+    assert ledger["first"]["requests"] > 0
+    assert ledger["second"]["requests"] > 0
+    assert ledger["second"]["cache_hits"] > 0  # warm start
+    ok, report = sched.check_compile_budgets()
+    assert "tenant=first" in report and "tenant=second" in report
+
+
+def test_compile_budget_gate_trips_on_cache_blower(ds8):
+    """A tenant whose compile requests exceed its drive's pinned ceiling
+    FAILs the gate; a tenant within budget passes; a drive without a
+    pinned ceiling is a SKIP, never a FAIL."""
+    sched = Scheduler(tracer=Tracer())
+    sched.submit(_desc("polite", ds8, seed=0, comm_round=1))
+    sched.submit(_desc("blower", ds8, seed=1, comm_round=1))
+    sched.submit(_desc("unpinned", ds8, seed=2, comm_round=1,
+                       buffer_size=5))
+    # synthetic ledger: the gate reads the ledger, not the trace
+    sched.compile_ledger["polite"] = {"requests": 3, "cache_hits": 3,
+                                      "cache_misses": 0}
+    sched.compile_ledger["blower"] = {"requests": 99, "cache_hits": 0,
+                                      "cache_misses": 99}
+    sched.compile_ledger["unpinned"] = {"requests": 7, "cache_hits": 0,
+                                        "cache_misses": 7}
+    budgets = {"eager": {"max_compiles": 4}, "buffered": {}}
+    ok, report = sched.check_compile_budgets(budgets)
+    sched.close()
+    assert not ok
+    lines = report.splitlines()
+    assert any(ln.startswith("OK tenant=polite") for ln in lines)
+    assert any(ln.startswith("FAIL tenant=blower") for ln in lines)
+    assert any(ln.startswith("SKIP tenant=unpinned") for ln in lines)
+    # within-ceiling world: the same queue passes
+    sched.compile_ledger["blower"]["requests"] = 4
+    ok2, _ = sched.check_compile_budgets(budgets)
+    assert ok2
+
+
+def test_serving_budget_entry_matches_enumeration():
+    """COMPILE_BUDGET.json's serving entry pins the union of the eager and
+    buffered program sets — regenerate with the analysis CLI if this
+    drifts."""
+    from fedml_tpu.analysis.targets import enumerate_drive_programs
+    from fedml_tpu.serving.scheduler import load_compile_budgets
+
+    budgets = load_compile_budgets()
+    entry = budgets["serving"]
+    programs = enumerate_drive_programs("serving")
+    assert entry["programs"] == programs
+    assert entry["static_total"] == sum(programs.values())

@@ -110,6 +110,7 @@ _SAMPLE_EVENTS = {
     "download_retry": dict(attempt=0, status="503", backoff_s=1.5),
     "trace_rotated": dict(rotated_to="TRACE.jsonl.000", segment=0, bytes=1024),
     "client_flagged": dict(client=17, reason="quarantine_recidivist", value=3),
+    "job_committed": dict(job="tenant-a", rounds=10, wall_s=1.25),
 }
 
 

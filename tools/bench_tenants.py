@@ -1,0 +1,205 @@
+"""Open-loop multi-tenant traffic generator for the graft-serve scheduler.
+
+Drives N tenant jobs (alternating sync-eager and buffered-with-stragglers
+kinds, the buffered ones in partial-cohort dispatch mode) at a target
+arrival rate against ONE shared 1M-client mmap shard store, through one
+`serving.Scheduler` on one device mesh. Open loop means arrivals are
+scheduled by the clock, not by completions: job i is submitted at
+`i / rate` seconds whether or not earlier tenants finished, so queueing
+delay shows up in job latency instead of being hidden by backpressure.
+
+Reported per run: jobs/s, p50/p95 job latency (completion minus SCHEDULED
+arrival), and per-tenant rounds/s under multiplexing plus each tenant's
+compile ledger (requests / cache hits / misses attributed by the
+scheduler). The artifact's `parsed` block has NO top-level
+`rounds_per_sec` key and the perf gate name-skips `BENCH_TENANTS_*` — a
+multi-tenant jobs/s number must never be compared against the single-drive
+rounds/s baselines.
+
+Env knobs:
+  BENCH_TENANTS_JOBS=4                       tenant jobs to submit (>= 3
+                                             for the acceptance run)
+  BENCH_TENANTS_RATE=0.5                     target arrival rate, jobs/s
+  BENCH_TENANTS_ROUNDS=5                     round budget per job
+  BENCH_TENANTS_CLIENTS=1000000              federation size (synthetic
+                                             sparse store; holes read 0)
+  BENCH_TENANTS_POLICY=fair_share            round_robin | fair_share
+  BENCH_TENANTS_OUT=BENCH_TENANTS_r01.json   '' to skip the artifact
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# bench_scale geometry: "lr" over flat 32-f32 samples — the point is the
+# scheduler and the data plane, not the matmul
+SHAPE, CLASSES, N_MAX, CPR, BATCH = (32,), 10, 20, 64, 20
+
+
+def _pct(sorted_vals, q):
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def build_descriptors(n_jobs, rounds, dataset):
+    """Alternating tenant kinds, each with its own seed so no two tenants
+    share a cohort stream: even slots are sync-eager jobs, odd slots are
+    buffered jobs with a straggler plan, dispatched partial-cohort."""
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.robustness.chaos import FaultPlan
+    from fedml_tpu.serving import JobDescriptor
+
+    descs = []
+    for i in range(n_jobs):
+        buffered = i % 2 == 1
+        cfg = FedConfig(
+            dataset="tenants_surrogate", model="lr", comm_round=rounds,
+            batch_size=BATCH, epochs=1, lr=0.1, seed=i, ci=1,
+            client_num_in_total=dataset.client_num,
+            client_num_per_round=CPR, frequency_of_the_test=10**9,
+            fast_sampling=True,
+            buffer_size=16 if buffered else 0,
+            staleness_alpha=0.5 if buffered else 0.0)
+        chaos = (FaultPlan(seed=100 + i, straggler_rate=0.3,
+                           straggler_rounds=2) if buffered else None)
+        descs.append(JobDescriptor(
+            name=f"tenant-{i:02d}-{'buf' if buffered else 'sync'}",
+            config=cfg, dataset=dataset, chaos=chaos,
+            weight=2.0 if buffered else 1.0,
+            partial_dispatch=buffered))
+    return descs
+
+
+def run_bench(n_jobs, rate, rounds, clients, policy):
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu import telemetry
+    from fedml_tpu.data.packed_store import (MmapPackedStore,
+                                             create_synthetic_store)
+    from fedml_tpu.data.registry import FederatedDataset
+    from fedml_tpu.serving import Scheduler
+    from fedml_tpu.telemetry.tracer import Tracer
+
+    store_dir = tempfile.mkdtemp(prefix=f"bench_tenants_{clients}_")
+    try:
+        t0 = time.perf_counter()
+        create_synthetic_store(store_dir, clients, n_max=N_MAX,
+                               sample_shape=SHAPE)
+        build_s = time.perf_counter() - t0
+        store = MmapPackedStore(store_dir)
+        rng = np.random.RandomState(0)
+        gx = rng.rand(64, *SHAPE).astype(np.float32)
+        gy = rng.randint(0, CLASSES, size=64).astype(np.int32)
+        ds = FederatedDataset(name="tenants_surrogate", train=store,
+                              test=None, train_global=(gx, gy),
+                              test_global=(gx, gy), class_num=CLASSES,
+                              meta={})
+
+        descs = build_descriptors(n_jobs, rounds, ds)
+        tracer = Tracer()
+        sched = Scheduler(policy=policy, tracer=tracer)
+
+        # open loop: job i's arrival is scheduled at start + i/rate,
+        # independent of completions (tracer.now() and these marks share
+        # the perf_counter timebase)
+        start = time.perf_counter()
+        arrivals = [start + i / rate for i in range(n_jobs)]
+        next_i = 0
+        telemetry.install(tracer)
+        try:
+            while next_i < n_jobs or not sched.queue.all_done():
+                now = time.perf_counter()
+                while next_i < n_jobs and arrivals[next_i] <= now:
+                    sched.submit(descs[next_i], submit_t=arrivals[next_i])
+                    next_i += 1
+                if sched.queue.active():
+                    sched.tick()
+                elif next_i < n_jobs:
+                    time.sleep(max(0.0,
+                                   arrivals[next_i] - time.perf_counter()))
+        finally:
+            telemetry.uninstall(tracer)
+            sched.close()
+
+        last_finish = max(j.finish_t for j in sched.queue)
+        wall_s = last_finish - start
+        latencies = sorted(j.finish_t - j.submit_t for j in sched.queue)
+        tenants = {}
+        for job in sched.queue:
+            active_s = max(job.finish_t - job.start_t, 1e-9)
+            tenants[job.name] = {
+                "kind": job.desc.kind,
+                "partial_dispatch": job.desc.partial_dispatch,
+                "rounds": job.round_idx,
+                "rounds_per_sec": round(job.round_idx / active_s, 4),
+                "latency_s": round(job.finish_t - job.submit_t, 4),
+                "dispatched_ticks": job.dispatched_ticks,
+                "compile": sched.compile_ledger.get(job.name),
+            }
+        cores = os.cpu_count() or 1
+        result = {
+            "metric": "serving_multitenant_jobs_per_sec",
+            "unit": "jobs/s through one scheduler at an open-loop arrival "
+                    "rate (latency = completion - scheduled arrival)",
+            "jobs": n_jobs,
+            "arrival_rate_jobs_per_sec": rate,
+            "rounds_per_job": rounds,
+            "policy": policy,
+            "jobs_per_sec": round(n_jobs / wall_s, 4),
+            "latency_p50_s": round(_pct(latencies, 0.5), 4),
+            "latency_p95_s": round(_pct(latencies, 0.95), 4),
+            "wall_s": round(wall_s, 4),
+            "tenants": tenants,
+            "clients": clients,
+            "clients_per_round": CPR,
+            "n_max": N_MAX,
+            "sample_shape": list(SHAPE),
+            "model": "lr",
+            "store_build_s": round(build_s, 3),
+            "scheduler_ticks": sched.ticks,
+            "job_committed_events": len(tracer.find_events("job_committed")),
+            "platform": jax.devices()[0].platform,
+            "cpu_cores": cores,
+            "cpu_capped": cores < 2,
+        }
+        store.close()
+        return result
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def main():
+    n_jobs = int(os.environ.get("BENCH_TENANTS_JOBS", "4"))
+    rate = float(os.environ.get("BENCH_TENANTS_RATE", "0.5"))
+    rounds = int(os.environ.get("BENCH_TENANTS_ROUNDS", "5"))
+    clients = int(os.environ.get("BENCH_TENANTS_CLIENTS", "1000000"))
+    policy = os.environ.get("BENCH_TENANTS_POLICY", "fair_share")
+
+    parsed = run_bench(n_jobs, rate, rounds, clients, policy)
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_TENANTS_OUT", "BENCH_TENANTS_r01.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": parsed["jobs"],
+                       "cmd": "python tools/bench_tenants.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
