@@ -73,6 +73,29 @@ assert any(f.rule == "comms-budget" and f.target == name
 print("OK comms budget trips on tensor.round regression")
 EOF
 
+echo "== codec comms self-test: a tightened topk16 ceiling must trip"
+# the --comms run above already gated the codec-on program twins against
+# their committed entries (they are regular PROGRAMS, not --fast-skipped
+# extras); here the topk16 admit budget is doctored 2x tighter and the
+# gate must fire with the measured-vs-ceiling diff, proving the codec
+# entries are live gates and not dead pins
+python - <<'EOF'
+import json, tempfile, os
+from fedml_tpu.analysis.comms import run_comms
+name = "buffered.admit[lr,f32,topk16]"
+budgets = json.load(open("COMMS_BUDGET.json"))
+budgets[name]["collective_bytes"] //= 2
+with tempfile.TemporaryDirectory() as d:
+    with open(os.path.join(d, "COMMS_BUDGET.json"), "w") as f:
+        json.dump(budgets, f)
+    report, _ = run_comms(d, targets=[name])
+assert not report.ok, "tightened topk16 budget failed to trip the comms gate"
+finding = next(f for f in report.findings
+               if f.rule == "comms-budget" and f.target == name)
+assert "bytes" in finding.message, finding
+print("OK comms budget trips on codec-on admit regression:", finding.message)
+EOF
+
 echo "== graft-lint compile layer (retrace budgets vs COMPILE_BUDGET.json)"
 # enumerates every jit entry point reachable from each drive config and
 # pins the exact compiled-program counts, plus the AST retrace-risk /
@@ -156,6 +179,20 @@ assert_summary "quarantined_count" 1 7
 assert_summary "participated_count" 1 7
 assert_summary "Test/Loss" 0 10
 assert_summary "Test/Acc" 0.0 1.0
+
+echo "== codec smoke (depth-2 chaos drive with --update_codec int8)"
+# the compressed-transport drive must survive the same chaos: int8-encoded
+# updates with error-feedback residuals through the depth-2 async loop,
+# quarantine and guard active — finite loss proves decode+EF keeps the
+# trajectory sane end to end at the CLI level
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 2 \
+  --epochs 1 --batch_size 4 --pipeline_depth 2 \
+  --chaos 1 --chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 --guard 1 \
+  --update_codec int8
+assert_summary "Test/Loss" 0 10
+assert_summary "Test/Acc" 0.0 1.0
+assert_summary "quarantined_count" 1 7
 
 echo "== graft-trace smoke (depth-2 chaos drive: --trace_summary + span coverage)"
 # same chaos workload, pipelined, with the tracer's p50/p95 table on stdout;

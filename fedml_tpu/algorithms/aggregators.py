@@ -386,7 +386,7 @@ def make_staleness_discount(alpha: float):
     return discount
 
 
-def build_buffer_admit(donate_buffer: bool = False):
+def build_buffer_admit(donate_buffer: bool = False, codec=None):
     """Jitted admit program: write one client row of a stacked LocalResult
     into the K-row update buffer at index `fill`, tagged with its birth
     round, and advance fill.
@@ -396,10 +396,20 @@ def build_buffer_admit(donate_buffer: bool = False):
     `donate_buffer=True` donates the buffer into the program so XLA updates
     the K-row copy in place — only safe when no guard snapshot holds the
     old buffer's arrays (the drive loop gates it, mirroring the pipelined
-    loop's donate-when-restageable rule)."""
+    loop's donate-when-restageable rule).
+
+    `codec` (fedml_tpu.codecs) arms the compressed-update admit: the row's
+    delta against the dispatch globals crosses into the buffer
+    encode->decode'd (memoryless — admitted rows are ephemeral senders, no
+    residual slot to carry), so the buffer stores what the wire DELIVERED
+    and the commit program is untouched. Codec-on admit takes a trailing
+    `global_variables` arg — a different jit signature, hence its own
+    COMPILE/COMMS budget program. The sharded twin
+    (parallel.sharded.build_sharded_buffer_fns) moves the encoded payload
+    over a real masked psum; here the simulation keeps bit-parity with it."""
 
     def admit(buf, stacked_vars, stacked_steps, stacked_metrics, counts,
-              src, birth_round):
+              src, birth_round, global_variables=None):
         def take(leaf):
             return jax.lax.dynamic_index_in_dim(leaf, src, 0, keepdims=False)
 
@@ -407,9 +417,20 @@ def build_buffer_admit(donate_buffer: bool = False):
             return jax.lax.dynamic_update_index_in_dim(
                 row_buf, row.astype(row_buf.dtype), buf["fill"], 0)
 
+        row_vars = jax.tree.map(take, stacked_vars)
+        if codec is not None:
+            delta = jax.tree.map(
+                lambda r, g: r - g
+                if jnp.issubdtype(r.dtype, jnp.inexact) else r,
+                row_vars, global_variables)
+            payload, _ = codec.encode(delta, codec.init_state(delta))
+            dec = codec.decode(payload, delta)
+            row_vars = jax.tree.map(
+                lambda g, d, r: (g + d).astype(r.dtype)
+                if jnp.issubdtype(r.dtype, jnp.inexact) else d,
+                global_variables, dec, row_vars)
         return {
-            "vars": jax.tree.map(put, buf["vars"],
-                                 jax.tree.map(take, stacked_vars)),
+            "vars": jax.tree.map(put, buf["vars"], row_vars),
             "steps": put(buf["steps"], take(stacked_steps)),
             "weights": put(buf["weights"],
                            take(counts).astype(jnp.float32)),
@@ -421,7 +442,8 @@ def build_buffer_admit(donate_buffer: bool = False):
 
     from fedml_tpu import telemetry
     telemetry.emit("round_fn_built", program="buffered.admit",
-                   donate=donate_buffer)
+                   donate=donate_buffer,
+                   codec=(codec.name if codec is not None else "none"))
     if not donate_buffer:
         return jax.jit(admit)
     jitted = jax.jit(admit, donate_argnums=(0,))

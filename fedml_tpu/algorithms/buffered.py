@@ -188,7 +188,9 @@ class BufferedRunner:
         self.partial_dispatch = bool(partial_dispatch)
         # a guard snapshot holds the buffer's arrays — donation would
         # deallocate them (the donate-when-restageable rule)
-        self.admit_fn = build_buffer_admit(donate_buffer=guard is None)
+        self.codec = getattr(api, "codec", None)
+        self.admit_fn = build_buffer_admit(donate_buffer=guard is None,
+                                           codec=self.codec)
         self.commit_fn = build_buffer_commit(api.aggregator, discount_fn)
         # stats are always collected (the traced program must not depend on
         # whether a ledger happens to be attached — ledger on/off
@@ -270,9 +272,15 @@ class BufferedRunner:
         for birth, slot in due:
             src = host.pending[birth]
             with tracer.span("admit", now):
-                api._buffer = self.admit_fn(
-                    api._buffer, src["vars"], src["steps"], src["metrics"],
-                    src["counts"], np.int32(slot), np.int32(birth))
+                args = (api._buffer, src["vars"], src["steps"],
+                        src["metrics"], src["counts"], np.int32(slot),
+                        np.int32(birth))
+                if self.codec is not None:
+                    # codec-on admit decodes the row's delta against the
+                    # CURRENT globals — the same reference the commit's
+                    # aggregation applies it to
+                    args = args + (api.global_variables,)
+                api._buffer = self.admit_fn(*args)
             host.fill += 1
             self.in_flight -= 1
             host.births.append(birth)

@@ -441,7 +441,8 @@ def build_round_fn_from_update(batched_update, aggregator,
 def build_round_fn(trainer, cfg: FedConfig, aggregator,
                    donate_data: bool = False,
                    param_sharding=None,
-                   collect_stats: bool = False) -> Callable:
+                   collect_stats: bool = False,
+                   codec=None) -> Callable:
     """Jitted synchronous round: vmap(local_update) + aggregate.
 
     `param_sharding` (a parallel.tensor.TensorSharding) switches the round
@@ -454,6 +455,18 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
     per-cohort `cohort_stats` health rows for the client ledger — from the
     SAME traced program (extra outputs, not extra programs or sync points).
     The default traces the exact legacy 3-tuple program.
+
+    `codec` (a fedml_tpu.codecs codec, or None) arms the compressed update
+    transport. On the vmap path the aggregator is wrapped with the
+    per-client encode/decode stage and the agg state extends to
+    {"agg": inner, "codec": residual_rows} — callers that own agg_state
+    init (FedAvgAPI) wrap the aggregator themselves BEFORE init_state and
+    pass `codec=None` here to avoid double wrapping. On the tensor path
+    the codec swaps the round's collectives for encoded payloads
+    (quantized gather downlink, int8-psum / top-k-gather uplink) — the
+    codec-on COMMS_BUDGET.json entries pin that program. `codec=None`
+    (and an unwrapped aggregator) traces the exact legacy program —
+    codec-off rounds stay bit-identical.
     """
     if param_sharding is not None:
         from fedml_tpu.parallel.tensor import build_tensor_round_fn
@@ -461,7 +474,14 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
         return build_tensor_round_fn(
             trainer, cfg, aggregator, param_sharding,
             donate_state=bool(cfg.extra.get("donate_params", False)),
-            donate_data=donate_data, collect_stats=collect_stats)
+            donate_data=donate_data, collect_stats=collect_stats,
+            codec=codec)
+    if codec is not None:
+        from fedml_tpu.codecs.transport import CodecAggregator
+
+        if not isinstance(aggregator, CodecAggregator):
+            aggregator = CodecAggregator(codec, aggregator,
+                                         slots=cfg.client_num_per_round)
     return build_round_fn_from_update(_vmapped_update(trainer, cfg),
                                       aggregator, donate_data=donate_data,
                                       collect_stats=collect_stats)

@@ -116,6 +116,17 @@ class FedAvgAPI(Checkpointable):
         self.aggregator = make_aggregator(aggregator_name, config)
         self.mesh = None
         self._tensor_sharding = None
+        from fedml_tpu.codecs import make_codec
+
+        # the compressed-update-transport seam (graft-codec): None keeps
+        # every code path EXACTLY as before — codec-off rounds are
+        # bit-identical by construction, not by tolerance
+        self.codec = make_codec(config.update_codec, config)
+        if self.codec is not None and config.silo_threshold > 0:
+            raise ValueError(
+                "update_codec has no seam in the silo-grouped lowering "
+                "(silos merge clients before any update crosses a wire) — "
+                "drop one of update_codec / silo_threshold")
         if config.buffer_size > 0 and (
                 config.backend != "vmap" or config.tensor_shards > 0
                 or config.silo_threshold > 0):
@@ -151,11 +162,15 @@ class FedAvgAPI(Checkpointable):
         # legacy 3-tuple default, so COMPILE/COMMS budgets are untouched.
         self._round_has_stats = True
         if config.tensor_shards > 0:
+            # tensor path keeps the INNER aggregator — the codec lives in
+            # the round's own wire transports (build_tensor_round_fn), and
+            # init_codec_agg_state below extends the state
             self.round_fn = build_round_fn(
                 model_trainer, config, self.aggregator,
                 donate_data=config.pipeline_depth > 0,
                 param_sharding=self._tensor_sharding,
-                collect_stats=True)
+                collect_stats=True,
+                codec=self.codec)
         elif config.backend == "shard_map":
             from fedml_tpu.parallel import build_sharded_round_fn, make_mesh
 
@@ -163,6 +178,16 @@ class FedAvgAPI(Checkpointable):
             # (groups/stages) belong to the hierarchical / splitnn APIs
             shape = (int(np.prod(config.mesh_shape)),) if config.mesh_shape else None
             self.mesh = make_mesh(shape, axis_names=("clients",))
+            if self.codec is not None:
+                from fedml_tpu.codecs.transport import CodecAggregator
+
+                # residual slots span the PADDED cohort (pad_clients rounds
+                # the width up to a mesh multiple before dispatch)
+                n_ax = self.mesh.shape["clients"]
+                slots = min(config.client_num_per_round, dataset.client_num)
+                slots = -(-slots // n_ax) * n_ax
+                self.aggregator = CodecAggregator(
+                    self.codec, self.aggregator, slots)
             self.round_fn = build_sharded_round_fn(
                 model_trainer, config, self.aggregator, self.mesh,
                 collect_stats=True
@@ -178,6 +203,18 @@ class FedAvgAPI(Checkpointable):
                 silo_trainer(model_trainer, config.silo_threshold),
                 config, self.aggregator)
         else:
+            if self.codec is not None and config.buffer_size == 0:
+                from fedml_tpu.codecs.transport import CodecAggregator
+
+                # sync vmap/pipelined drives: wrap the aggregator HERE (not
+                # inside build_round_fn) so init_state below yields the
+                # extended {"agg", "codec"} tree that checkpoints, guard
+                # snapshots and donation all ride. Buffered drives keep the
+                # inner aggregator — their codec stage lives at admit
+                # (algorithms/buffered.py), commits aggregate decoded rows.
+                slots = min(config.client_num_per_round, dataset.client_num)
+                self.aggregator = CodecAggregator(
+                    self.codec, self.aggregator, slots)
             # the pipelined drive loop stages a fresh device copy of the
             # cohort every round, so its buffers can be donated into the
             # round; eager callers (bench.py re-feeds one staged cohort)
@@ -209,7 +246,14 @@ class FedAvgAPI(Checkpointable):
             # from then on
             self.global_variables = self._tensor_sharding.place(
                 self.global_variables)
-            self.agg_state = self._tensor_sharding.place(self.agg_state)
+            if self.codec is not None:
+                from fedml_tpu.parallel.tensor import init_codec_agg_state
+
+                self.agg_state = init_codec_agg_state(
+                    self._tensor_sharding, self.global_variables,
+                    self.agg_state)
+            else:
+                self.agg_state = self._tensor_sharding.place(self.agg_state)
 
         bs = config.batch_size if config.batch_size > 0 else 256
         self._test_batches = pack_eval_batches(*dataset.test_global, max(bs, 64))

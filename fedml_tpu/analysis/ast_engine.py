@@ -44,6 +44,13 @@ itself the `bare-suppression` finding):
   (reconnects, rollbacks) is exactly when you can least afford a crash.
   Non-literal kinds (the seam's own `tracer.event(kind, ...)` forward) are
   skipped: the rule is a static spelling check, not a dataflow analysis.
+- `unregistered-codec` (algorithms/, parallel/, serving/ only): a direct
+  `Int8Codec(...)` / `TopKCodec(...)` constructor call outside
+  `fedml_tpu/codecs/` — codecs must come from `fedml_tpu.codecs.make_codec`
+  so the CLI/config name, the COMMS/COMPILE budget program names, and the
+  codec-off bit-identity contract stay in sync; a hand-built codec with
+  ad-hoc parameters would run under a budget pin measured for different
+  wire bytes.
 - `full-store-materialize`: `np.asarray(store.x)` / `np.stack(...)` /
   `store.x[:]` whole-store reads over a packed/streaming client store —
   the data plane's O(cohort) contract (data/packed_store.py) dies the
@@ -651,6 +658,40 @@ class _UnschemaEvent(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _UnregisteredCodec(ast.NodeVisitor):
+    """unregistered-codec: update codecs are built ONLY by make_codec.
+
+    Scope: the codec-armed data-plane packages (algorithms/, parallel/,
+    serving/). A direct `Int8Codec(...)` / `TopKCodec(...)` call there
+    bypasses the registry — its bits/k come from call-site literals instead
+    of FedConfig, so the `--update_codec` CLI, the budget program names
+    (`...,int8]` / `...,topk64]`), and the codec-off bit-identity tests all
+    describe a codec the round isn't actually running. Dotted spellings
+    (`int8.Int8Codec`, `codecs.topk.TopKCodec`) match too; the
+    `CodecAggregator` wrapper is exempt — round builders construct it
+    around a make_codec-produced codec by design."""
+
+    _CODEC_CTORS = {"Int8Codec", "TopKCodec"}
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name and name.split(".")[-1] in self._CODEC_CTORS \
+                and not is_suppressed(self.lines, node.lineno,
+                                      "unregistered-codec"):
+            self.findings.append(Finding(
+                "unregistered-codec", f"{self.path}:{node.lineno}",
+                f"`{name}(...)` constructs an update codec directly — build "
+                f"it with `fedml_tpu.codecs.make_codec(cfg.update_codec, "
+                f"cfg)` so the codec's parameters come from FedConfig and "
+                f"match the COMMS/COMPILE budget program twins"))
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -673,9 +714,15 @@ def lint_source(source: str, path: str) -> List[Finding]:
     # drive-loop fetch hygiene is an algorithms/-driver contract: that is
     # where the untraced round loops live (lint_tree hands us repo-relative
     # paths, so the scope survives any checkout location)
-    if "algorithms" in path.replace(os.sep, "/").split("/"):
+    parts = path.replace(os.sep, "/").split("/")
+    if "algorithms" in parts:
         _DriveLoopFetch(path, lines, findings).visit(tree)
         _NakedTimer(path, lines, findings).visit(tree)
+    # codec registry discipline is a data-plane contract: these are the
+    # packages whose rounds the codec budget twins pin (codecs/ itself is
+    # out of scope — it's where the constructors legitimately live)
+    if {"algorithms", "parallel", "serving"} & set(parts):
+        _UnregisteredCodec(path, lines, findings).visit(tree)
     # compile-layer rules (engine #4) ride the same sweep so LINT.json and
     # the repo-clean pins cover them; late import avoids a module cycle
     from fedml_tpu.analysis.compile_engine import lint_compile_tree

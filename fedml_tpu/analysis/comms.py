@@ -165,17 +165,27 @@ def _ulysses_attention():
     return fn, (s, s, s), None
 
 
-def _tensor_round(model_name: str, agg_name: str):
+def _tensor_round(model_name: str, agg_name: str,
+                  codec_name: Optional[str] = None, codec_k: int = 64):
     """A 2x4 ('clients', 'tensor') tensor-sharded round
     (parallel/tensor.py): params + aggregator state enter sharded, the
     round gathers per leaf at entry and slices before the client psums —
     so the budget pins BOTH the all_gather cost of the gathered client
-    step and the 1/|tensor| aggregation traffic."""
+    step and the 1/|tensor| aggregation traffic.
+
+    `codec_name` builds the codec-on twin (graft-codec): the entry gather
+    moves int8 payloads + per-shard scales, the clients-axis reduction
+    moves the codec's encoded partial sums (shared-scale s8 psums, or
+    top-k (values, idx) all_gathers). Its COMMS entry is the headline
+    wire-shrink gate — the top-k variant must show >=4x fewer collective
+    bytes than the codec-off twin (tests/test_codecs.py pins the ratio
+    from the committed budgets)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
 
     from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.codecs import make_codec
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.parallel.tensor import (TensorSharding,
                                            build_tensor_round_fn)
@@ -183,7 +193,8 @@ def _tensor_round(model_name: str, agg_name: str):
     mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(2, 4),
                 ("clients", "tensor"))
     cfg = FedConfig(model=model_name, batch_size=2, epochs=1,
-                    dtype="float32", server_optimizer="adam", server_lr=0.01)
+                    dtype="float32", server_optimizer="adam", server_lr=0.01,
+                    update_codec=codec_name or "none", codec_k=codec_k)
     if model_name == "lr":
         trainer = _lr_trainer()
         gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
@@ -198,16 +209,29 @@ def _tensor_round(model_name: str, agg_name: str):
         data = (jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
                 jax.ShapeDtypeStruct((2, 4, 16), jnp.int32))
     agg = make_aggregator(agg_name, cfg)
+    codec = make_codec(cfg.update_codec, cfg)
     round_fn = build_tensor_round_fn(
         trainer, cfg, agg, TensorSharding.for_model(mesh, model_name),
-        donate_state=True)
-    agg_state = jax.eval_shape(agg.init_state, gv)
+        donate_state=True, codec=codec)
+    if codec is None:
+        agg_state = jax.eval_shape(agg.init_state, gv)
+    else:
+        def init_st(g):
+            resid = jax.tree.map(
+                lambda l: jnp.zeros(
+                    (2,) + (l.shape
+                            if jnp.issubdtype(l.dtype, jnp.inexact)
+                            else ()), l.dtype), g)
+            return {"agg": agg.init_state(g), "codec": resid}
+
+        agg_state = jax.eval_shape(init_st, gv)
     args = (gv, agg_state) + data + (
         jax.ShapeDtypeStruct((2,), jnp.int32), rng)
     return round_fn, args, _tree_bytes(gv)
 
 
-def _buffered_program(which: str, agg_name: str):
+def _buffered_program(which: str, agg_name: str,
+                      codec_name: Optional[str] = None, codec_k: int = 16):
     """The buffered-aggregation admit/commit shard_map programs
     (parallel/sharded.py build_sharded_buffer_fns) on the 8-device clients
     mesh: buffer rows AND the stacked client-step result sharded over
@@ -224,12 +248,16 @@ def _buffered_program(which: str, agg_name: str):
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.parallel.sharded import build_sharded_buffer_fns
 
+    from fedml_tpu.codecs import make_codec
+
     mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("clients",))
     trainer = _lr_trainer()
-    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32",
+                    update_codec=codec_name or "none", codec_k=codec_k)
     agg = make_aggregator(agg_name, cfg)
+    codec = make_codec(cfg.update_codec, cfg)
     admit_fn, commit_fn = build_sharded_buffer_fns(
-        agg, make_staleness_discount(0.5), mesh)
+        agg, make_staleness_discount(0.5), mesh, codec=codec)
     gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
     c = k = N_DEV  # one stacked-result row and one buffer row per device
     i32 = lambda shape=(): jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
@@ -247,6 +275,9 @@ def _buffered_program(which: str, agg_name: str):
             (c,) + l.shape[1:], l.dtype), buf)
         args = (buf, i32(), stacked["vars"], stacked["steps"],
                 stacked["metrics"], i32((c,)), i32(), i32())
+        if codec is not None:
+            # codec-on admit takes the trailing replicated delta base
+            args = args + (gv,)
         return admit_fn, args, _tree_bytes(gv)
     agg_state = jax.eval_shape(agg.init_state, gv)
     args = (gv, agg_state, buf, i32(), i32(), rng)
@@ -316,8 +347,22 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
         lambda: _tensor_round("lr", "robust"), N_DEV),
     "tensor.round[lr,f32,fednova,2x4]": (
         lambda: _tensor_round("lr", "fednova"), N_DEV),
+    # graft-codec twins: same programs with the update codec on the wire.
+    # topk entries are the headline >=4x-fewer-bytes gates (vs their
+    # codec-off twins above/below); int8 twins are pinned too — they land
+    # just under 4x (payload/4 alone nearly exhausts the quota; the scale
+    # sidecars tip it) and PERF.md documents the honest numbers.
+    "tensor.round[tformer,f32,fedavg,2x4,int8]": (
+        lambda: _tensor_round("transformer_nwp", "fedavg", "int8"), N_DEV),
+    "tensor.round[tformer,f32,fedavg,2x4,topk64]": (
+        lambda: _tensor_round("transformer_nwp", "fedavg", "topk", 64),
+        N_DEV),
     "buffered.admit[lr,f32]": (
         lambda: _buffered_program("admit", "fedavg"), N_DEV),
+    "buffered.admit[lr,f32,int8]": (
+        lambda: _buffered_program("admit", "fedavg", "int8"), N_DEV),
+    "buffered.admit[lr,f32,topk16]": (
+        lambda: _buffered_program("admit", "fedavg", "topk", 16), N_DEV),
     "buffered.commit[lr,f32,fedavg]": (
         lambda: _buffered_program("commit", "fedavg"), N_DEV),
     "buffered.commit[lr,f32,fedopt]": (

@@ -56,6 +56,11 @@ RULES = {
                        "outside a `with self._lock` block",
     "rng-key-reuse": "PRNG key consumed by two jitted calls without an "
                      "intervening fold_in/split (identical randomness)",
+    "unregistered-codec": "Int8Codec/TopKCodec constructed directly in "
+                          "algorithms//parallel//serving/ instead of via "
+                          "fedml_tpu.codecs.make_codec (call-site literals "
+                          "desync the codec from FedConfig and its budget "
+                          "program twins)",
     "bare-suppression": "graft-lint: disable comment without a '-- reason'",
     "unschema-event": "tracer.event()/telemetry.emit() with a literal kind "
                       "that is not in EVENT_SCHEMAS (the call raises "

@@ -334,10 +334,22 @@ def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
             "engine.federation_eval[lr,f32]": 2}
 
 
+def _drive_codecs(cfg, codec_k: int):
+    """The codec-on program variants every codec-armed drive pins: the int8
+    quantizer and the top-k sparsifier at the drive's COMMS-budget k."""
+    from fedml_tpu.codecs import make_codec
+
+    return (make_codec("int8", cfg),
+            make_codec("topk", {"codec_k": codec_k}))
+
+
 def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
-                             rng) -> dict:
+                             rng, codecs=()) -> dict:
     """Abstractly trace the buffered drive's three jit programs (client
-    step, admit, commit) — shared by the buffered and serving enumerations."""
+    step, admit, commit) — shared by the buffered and serving enumerations.
+    `codecs` adds the codec-on admit variants (graft-codec): each codec's
+    admit takes the trailing replicated delta base, a distinct jit
+    signature the budget pins as its own program."""
     from fedml_tpu.algorithms.aggregators import (build_buffer_admit,
                                                   build_buffer_commit,
                                                   make_staleness_discount)
@@ -361,6 +373,11 @@ def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
                    result.num_steps, result.metrics, counts,
                    i32(), i32())
     programs["buffered.admit[lr,f32]"] = 1
+    for codec in codecs:
+        jax.eval_shape(build_buffer_admit(codec=codec), buf,
+                       result.variables, result.num_steps, result.metrics,
+                       counts, i32(), i32(), gv)
+        programs[f"buffered.admit[lr,f32,{codec.name}]"] = 1
     jax.eval_shape(build_buffer_commit(agg, make_staleness_discount(0.5)),
                    gv, agg_state, buf, i32(), rng)
     programs["buffered.commit[lr,f32,fedavg]"] = 1
@@ -397,18 +414,31 @@ def enumerate_drive_programs(drive: str) -> dict:
         jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng, part)
         programs["engine.round[lr,f32,fedavg,masked]"] = 1
     elif drive == "buffered":
+        # codec-on admit variants ride the same drive config (a
+        # --update_codec run reaches them); k matches the COMMS-budget twin
         programs.update(_trace_buffered_programs(
-            trainer, cfg, agg, gv, agg_state, x, y, counts, rng))
+            trainer, cfg, agg, gv, agg_state, x, y, counts, rng,
+            codecs=_drive_codecs(cfg, codec_k=16)))
     elif drive == "serving":
         # graft-serve multiplexes sync (eager) and buffered tenant jobs
         # over one mesh: its program set is the UNION of both drives —
         # each tenant's jit wrappers are its own, but the scheduler's
-        # worst-case static footprint is every program both kinds reach
+        # worst-case static footprint is every program both kinds reach,
+        # including per-tenant codec-on variants (JobDescriptor.codec)
+        from fedml_tpu.codecs.transport import CodecAggregator
+
         round_fn = build_round_fn(trainer, cfg, agg)
         jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
         programs["engine.round[lr,f32,fedavg]"] = 1
+        codecs = _drive_codecs(cfg, codec_k=16)
+        wrapped = CodecAggregator(codecs[0], agg, slots=2)
+        round_c = build_round_fn(trainer, cfg, wrapped)
+        jax.eval_shape(round_c, gv, jax.eval_shape(wrapped.init_state, gv),
+                       x, y, counts, rng)
+        programs["engine.round[lr,f32,fedavg,int8]"] = 1
         programs.update(_trace_buffered_programs(
-            trainer, cfg, agg, gv, agg_state, x, y, counts, rng))
+            trainer, cfg, agg, gv, agg_state, x, y, counts, rng,
+            codecs=codecs))
     elif drive == "tensor":
         from jax.sharding import Mesh
 
@@ -416,23 +446,52 @@ def enumerate_drive_programs(drive: str) -> dict:
                                                build_tensor_round_fn)
         mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
                     ("clients", "tensor"))
+        sharding = TensorSharding.for_model(mesh, "lr")
         round_fn = build_tensor_round_fn(
-            trainer, cfg, agg, TensorSharding.for_model(mesh, "lr"),
-            donate_state=True)
+            trainer, cfg, agg, sharding, donate_state=True)
         jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
         programs["tensor.round[lr,f32,fedavg,2x4]"] = 1
+        # graft-codec twins: the codec-on round carries the wrapped
+        # {"agg", "codec"} state (per-clients-device residual rows), a
+        # distinct signature per codec; k matches the COMMS-budget twin
+        for codec in _drive_codecs(cfg, codec_k=64):
+            round_c = build_tensor_round_fn(
+                trainer, cfg, agg, sharding, donate_state=True, codec=codec)
+
+            def init_st(g):
+                resid = jax.tree.map(
+                    lambda l: jnp.zeros(
+                        (2,) + (l.shape
+                                if jnp.issubdtype(l.dtype, jnp.inexact)
+                                else ()), l.dtype), g)
+                return {"agg": agg.init_state(g), "codec": resid}
+
+            jax.eval_shape(round_c, gv, jax.eval_shape(init_st, gv),
+                           x, y, counts, rng)
+            programs[f"tensor.round[lr,f32,fedavg,2x4,{codec.name}]"] = 1
     elif drive == "sharded":
         from jax.sharding import Mesh
 
+        from fedml_tpu.codecs.transport import CodecAggregator
         from fedml_tpu.parallel.sharded import build_sharded_round_fn
         mesh = Mesh(np.array(jax.devices()[:8]), ("clients",))
-        round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
         c = 8
-        jax.eval_shape(round_fn, gv, agg_state,
-                       jax.ShapeDtypeStruct((c, 4) + shape[1:], in_dtype),
-                       jax.ShapeDtypeStruct((c, 4), jnp.int32),
-                       jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+        sharded_args = (
+            jax.ShapeDtypeStruct((c, 4) + shape[1:], in_dtype),
+            jax.ShapeDtypeStruct((c, 4), jnp.int32),
+            jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+        round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
+        jax.eval_shape(round_fn, gv, agg_state, *sharded_args)
         programs["sharded.round[lr,f32,fedavg,8]"] = 1
+        # codec-on twin: shard_map round with the CodecAggregator state
+        # (one residual row per cohort slot, sharded over 'clients')
+        for codec in _drive_codecs(cfg, codec_k=64)[:1]:
+            wrapped = CodecAggregator(codec, agg, slots=c)
+            round_c = build_sharded_round_fn(trainer, cfg, wrapped, mesh)
+            jax.eval_shape(round_c, gv,
+                           jax.eval_shape(wrapped.init_state, gv),
+                           *sharded_args)
+            programs[f"sharded.round[lr,f32,fedavg,8,{codec.name}]"] = 1
     elif drive == "hierarchical":
         from jax.sharding import Mesh
 

@@ -1,0 +1,56 @@
+"""graft-codec: pluggable compressed update transport.
+
+A codec sits between the client step and the aggregator and shrinks the
+bytes an update puts on the wire.  Two families ship here:
+
+- ``int8``  — stochastic-free int8 quantization with a per-leaf scale and
+  error-feedback residuals (deterministic round-half-even + residual carry
+  is unbiased in the long run and keeps rounds bit-reproducible without
+  threading an rng through the transport).
+- ``topk``  — top-k sparsification emitting static-shape ``(values, idx)``
+  payloads, so jit signatures never change with the data and the compile
+  budgets hold.
+
+Codecs are constructed ONLY through :func:`make_codec` (graft-lint's
+``unregistered-codec`` rule enforces this outside this package), mirroring
+``make_aggregator`` / ``make_staleness_discount``.  ``make_codec("none")``
+returns ``None``, and every seam treats ``codec=None`` as the exact legacy
+program — codec-off rounds stay bit-identical to a build without this
+package.
+"""
+
+from .int8 import Int8Codec
+from .topk import TopKCodec
+
+CODECS = {
+    "int8": Int8Codec,
+    "topk": TopKCodec,
+}
+
+
+def make_codec(name, cfg=None):
+    """Build an update codec by name. ``none``/empty/None disables the seam.
+
+    ``cfg`` may be a FedConfig (reads ``codec_k`` / ``codec_bits``) or a
+    plain dict with the same keys.
+    """
+    if name is None or name in ("", "none"):
+        return None
+    if name not in CODECS:
+        raise ValueError(
+            "unknown update codec %r (have: %s)" % (name, sorted(CODECS))
+        )
+
+    def _get(key, default):
+        if cfg is None:
+            return default
+        if isinstance(cfg, dict):
+            return cfg.get(key, default)
+        return getattr(cfg, key, default)
+
+    if name == "int8":
+        return Int8Codec(bits=int(_get("codec_bits", 8)))
+    return TopKCodec(k=int(_get("codec_k", 64)))
+
+
+__all__ = ["CODECS", "make_codec", "Int8Codec", "TopKCodec"]

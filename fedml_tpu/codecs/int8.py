@@ -1,0 +1,97 @@
+"""int8 update codec: per-leaf scale + error-feedback residuals.
+
+``encode`` adds the carried residual to the update, quantizes each leaf to
+``codec_bits`` signed levels stored as int8, and returns the exact
+quantization error as the new residual, so the accounting identity
+
+    decode(payload) + new_residual == update + old_residual     (bitwise)
+
+holds leaf-by-leaf in f32 arithmetic (``new_residual`` is computed as
+``t - decode(payload)`` from the very same ``t``).  Rounding is
+deterministic (round-half-even) — the residual carry removes the bias a
+stochastic rounder would otherwise be needed for, and keeps every drive
+bit-reproducible.
+
+Payloads are a pair of parallel trees ``{"q": int8 leaves, "scale": f32
+scalars}``; the int8 leaves are what crosses a collective, which is how the
+HLO comms ledger sees the 4x dtype shrink.  ``bits`` < 8 narrows the level
+count (coarser quantization, same int8 wire type) — useful for psum
+transports that need contributor headroom.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_inexact(leaf):
+    return jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+
+
+class Int8Codec:
+    """Quantize inexact leaves to int8 with a per-leaf scale."""
+
+    kind = "int8"
+
+    def __init__(self, bits=8, headroom=1):
+        if not 2 <= int(bits) <= 8:
+            raise ValueError("codec_bits must be in [2, 8], got %r" % (bits,))
+        self.bits = int(bits)
+        # Reserve range so `headroom` independent contributors can be summed
+        # in int8 on the wire without overflow (sharded psum transport).
+        self.headroom = max(1, int(headroom))
+        self.levels = max(1, (2 ** (self.bits - 1) - 1) // self.headroom)
+        self.name = "int8" if self.bits == 8 else "int%d" % self.bits
+
+    def with_headroom(self, contributors):
+        return Int8Codec(bits=self.bits, headroom=contributors)
+
+    def init_state(self, tree):
+        """Zero residual tree shaped like one update (inexact leaves only)."""
+        return jax.tree_util.tree_map(
+            lambda l: jnp.zeros_like(l) if _is_inexact(l) else jnp.zeros((), l.dtype),
+            tree,
+        )
+
+    def _encode_leaf(self, leaf, resid):
+        t = leaf + resid
+        amax = jnp.max(jnp.abs(t))
+        scale = jnp.where(amax > 0, amax / self.levels, jnp.ones((), t.dtype))
+        q = jnp.clip(jnp.round(t / scale), -self.levels, self.levels).astype(jnp.int8)
+        dec = q.astype(t.dtype) * scale
+        return q, scale.astype(t.dtype), t - dec
+
+    def encode(self, tree, residual):
+        """-> (payload {"q","scale"}, new_residual). Non-inexact leaves pass through."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        rleaves = treedef.flatten_up_to(residual)
+        qs, scales, resids = [], [], []
+        for leaf, r in zip(leaves, rleaves):
+            if _is_inexact(leaf):
+                q, s, rn = self._encode_leaf(leaf, r)
+            else:
+                q, s, rn = leaf, jnp.zeros((), jnp.float32), r
+            qs.append(q)
+            scales.append(s)
+            resids.append(rn)
+        payload = {
+            "q": jax.tree_util.tree_unflatten(treedef, qs),
+            "scale": jax.tree_util.tree_unflatten(treedef, scales),
+        }
+        return payload, jax.tree_util.tree_unflatten(treedef, resids)
+
+    def decode(self, payload, like=None):
+        def _dec(q, s):
+            if jnp.issubdtype(jnp.asarray(q).dtype, jnp.signedinteger):
+                return q.astype(s.dtype) * s
+            return q
+        return jax.tree_util.tree_map(_dec, payload["q"], payload["scale"])
+
+    def wire_bytes(self, tree):
+        """Static wire-byte estimate: 1 byte/element + a 4-byte scale per leaf."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if _is_inexact(leaf):
+                total += int(leaf.size) + 4
+            else:
+                total += int(leaf.size) * jnp.asarray(leaf).dtype.itemsize
+        return total

@@ -1,0 +1,183 @@
+"""Codec transport stages — where encoded payloads actually cross the wire.
+
+Three seams, one codec interface:
+
+- :class:`CodecAggregator` wraps any aggregator with the per-client
+  encode/decode stage for the vmap and 1-D sharded rounds.  The
+  error-feedback residual rides the aggregator state as
+  ``{"agg": inner_state, "codec": residual_rows}`` — checkpointed, guard-
+  snapshotted and donated exactly like the FedOpt momenta, because it IS
+  agg state.  One residual row per cohort slot: slot i's quantization error
+  feeds slot i's next encode (a slot-level approximation of per-client
+  error feedback — documented in README §Compressed update transport).
+- :func:`transport_wsum` is the tensor-round uplink: each client-axis
+  device encodes its locally-weighted partial sum of update deltas (with a
+  device-resident residual) and the COLLECTIVE moves only the encoded
+  payload — an int8 psum under a shared scale, or an all_gather of
+  static-shape top-k ``(values, idx)`` pairs scatter-added locally.
+- :func:`masked_row_transport` is the buffered-admit fetch: the owning
+  device encodes one client row and the masked psum carries int8/top-k
+  payload leaves instead of a full-width f32 row.
+
+The vmap/sharded per-client stage is a transport *simulation* (no
+collective shrinks — the psum there is datacenter-internal); the tensor
+and sharded-admit stages shrink real HLO collective bytes, which is what
+the codec-on COMMS_BUDGET.json entries pin.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_inexact(leaf):
+    return jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+
+
+def slot_residual(codec, tree, slots):
+    """Per-cohort-slot residual state: (slots, *leaf.shape) zeros for
+    inexact leaves (scalar rows for passthrough leaves)."""
+    base = codec.init_state(tree)
+    return jax.tree_util.tree_map(
+        lambda l: jnp.zeros((slots,) + l.shape, l.dtype), base)
+
+
+class CodecAggregator:
+    """Aggregator wrapper: encode/decode per-client update deltas between
+    the client step and the wrapped rule, carrying per-slot error-feedback
+    residuals in the extended state dict.
+
+    Construct only through the round builders (which call
+    ``fedml_tpu.codecs.make_codec`` on FedConfig.update_codec) — graft-lint's
+    ``unregistered-codec`` rule pins that.
+    """
+
+    def __init__(self, codec, inner, slots):
+        self.codec = codec
+        self.inner = inner
+        self.slots = int(slots)
+
+    def init_state(self, global_variables):
+        return {
+            "agg": self.inner.init_state(global_variables),
+            "codec": slot_residual(self.codec, global_variables, self.slots),
+        }
+
+    def _stage(self, global_variables, result, weights, resid):
+        """Per-row encode -> wire -> decode; returns (decoded_result,
+        new_resid). Rows whose update is dead (zero weight) or non-finite
+        keep their old residual — garbage must not enter the carry."""
+        from fedml_tpu.algorithms.aggregators import client_finite_mask
+
+        codec = self.codec
+        deltas = jax.tree_util.tree_map(
+            lambda p, g: p - g[None] if _is_inexact(p) else p,
+            result.variables, global_variables)
+        payload, r_new = jax.vmap(codec.encode)(deltas, resid)
+        decoded = jax.vmap(lambda pl, like: codec.decode(pl, like))(
+            payload, deltas)
+        alive = (weights > 0) & client_finite_mask(result.variables)
+
+        def keep(n, o):
+            m = alive.reshape((-1,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        r_new = jax.tree_util.tree_map(keep, r_new, resid)
+        dec_vars = jax.tree_util.tree_map(
+            lambda g, d, p: (g[None] + d).astype(p.dtype)
+            if _is_inexact(p) else p,
+            global_variables, decoded, result.variables)
+        return result._replace(variables=dec_vars), r_new
+
+    def __call__(self, global_variables, result, weights, rng, state):
+        dec_result, r_new = self._stage(
+            global_variables, result, weights, state["codec"])
+        new_global, new_inner = self.inner(
+            global_variables, dec_result, weights, rng, state["agg"])
+        return new_global, {"agg": new_inner, "codec": r_new}
+
+    def sharded(self, global_variables, result, weights, rng, state, axis):
+        # rows (and their residual slots) are the LOCAL shard's — the round
+        # builder shards state["codec"] over the client axis
+        dec_result, r_new = self._stage(
+            global_variables, result, weights, state["codec"])
+        new_global, new_inner = self.inner.sharded(
+            global_variables, dec_result, weights, rng, state["agg"], axis)
+        return new_global, {"agg": new_inner, "codec": r_new}
+
+
+def transport_wsum(codec, wsum_tree, resid_tree, axis, contributors):
+    """Cross-device weighted-SUM transport with the payload encoded on the
+    wire. Each device contributes its local partial sum + residual; returns
+    (global_sum f32-exactness-of-codec, new_local_residual).
+
+    int8: a shared scale (pmax of per-device max|t|, one 4-byte collective
+    per leaf) lets every contributor quantize onto the same grid with
+    1/contributors headroom, so the s8 psum cannot overflow and the wire
+    payload is genuinely 1 byte/element.  top-k: contributors' static-shape
+    (values, idx) pairs ride an all_gather and are scatter-added locally —
+    indices differ per device, so a psum would be wrong, and gathered bytes
+    (contributors * 8k per leaf) stay far below params_bytes (the
+    accidental-replication lint keeps that honest).  Passthrough
+    (non-inexact) leaves move as plain psums."""
+    kind = codec.kind
+    if kind == "int8":
+        quant = codec.with_headroom(contributors)
+
+        def one(leaf, r):
+            if not _is_inexact(leaf):
+                return jax.lax.psum(leaf, axis), r
+            t = leaf + r
+            amax = jax.lax.pmax(jnp.max(jnp.abs(t)), axis)
+            scale = jnp.where(amax > 0, amax / quant.levels,
+                              jnp.ones((), t.dtype))
+            q = jnp.clip(jnp.round(t / scale), -quant.levels,
+                         quant.levels).astype(jnp.int8)
+            qsum = jax.lax.psum(q, axis)  # the int8 wire payload
+            dec_local = q.astype(t.dtype) * scale
+            return qsum.astype(t.dtype) * scale, t - dec_local
+    elif kind == "topk":
+        def one(leaf, r):
+            if not _is_inexact(leaf):
+                return jax.lax.psum(leaf, axis), r
+            t = leaf + r
+            flat = t.reshape(-1)
+            k = min(codec.k, int(flat.size))
+            _, idx = jax.lax.top_k(jnp.abs(flat), k)
+            idx = idx.astype(jnp.int32)
+            values = flat[idx]
+            g_idx = jax.lax.all_gather(idx, axis)       # (D, k) wire
+            g_val = jax.lax.all_gather(values, axis)    # (D, k) wire
+            total = jnp.zeros_like(flat).at[g_idx.reshape(-1)].add(
+                g_val.reshape(-1))
+            dec_local = jnp.zeros_like(flat).at[idx].set(values)
+            return (total.reshape(t.shape),
+                    t - dec_local.reshape(t.shape))
+    else:
+        raise ValueError("no wire transport for codec kind %r" % (kind,))
+
+    leaves, treedef = jax.tree_util.tree_flatten(wsum_tree)
+    rleaves = treedef.flatten_up_to(resid_tree)
+    sums, resids = [], []
+    for leaf, r in zip(leaves, rleaves):
+        s, rn = one(leaf, r)
+        sums.append(s)
+        resids.append(rn)
+    return (jax.tree_util.tree_unflatten(treedef, sums),
+            jax.tree_util.tree_unflatten(treedef, resids))
+
+
+def masked_row_transport(codec, delta_row, axis, has_src):
+    """One client row crosses the mesh encoded: the owning device's payload
+    rides masked psums (single contributor — exact for int8 grids and for
+    top-k index/value pairs alike), every other device contributes zeros.
+    Memoryless (no residual): admitted rows are ephemeral, there is no
+    persistent sender slot to carry feedback for."""
+    zeros = codec.init_state(delta_row)
+    payload, _ = codec.encode(delta_row, zeros)
+
+    def wire(leaf):
+        masked = jnp.where(has_src, leaf, jnp.zeros((), leaf.dtype))
+        return jax.lax.psum(masked, axis)
+
+    wired = jax.tree_util.tree_map(wire, payload)
+    return codec.decode(wired, delta_row)

@@ -119,6 +119,20 @@ class FedConfig:
     # at round t gets weight count * (1 + (t - b)) ** -alpha. 0 disables
     # discounting ((1+s)**-0 == 1.0 exactly, preserving bit-identity).
     staleness_alpha: float = 0.5
+    # Compressed update transport (fedml_tpu/codecs): "none" | "int8" |
+    # "topk". "none" takes the exact legacy code path in every round
+    # builder (bit-identical to a codec-free build); "int8" quantizes
+    # update payloads to int8 with a per-leaf scale and error-feedback
+    # residuals carried in agg state; "topk" ships static-shape
+    # (values, idx) sparse payloads so jit signatures never change.
+    update_codec: str = "none"
+    # top-k codec: entries kept per leaf (clamped to the leaf size — a
+    # static function of shapes, so compile counts stay flat).
+    codec_k: int = 64
+    # int8 codec: quantization level width in bits (2..8); payloads are
+    # stored/transported as int8 regardless, fewer bits just coarsen the
+    # grid (used for psum transports that need contributor headroom).
+    codec_bits: int = 8
     dtype: str = "float32"  # compute dtype; bfloat16 for MXU-heavy models
 
     extra: dict[str, Any] = field(default_factory=dict, hash=False, compare=False)

@@ -95,6 +95,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--staleness_alpha", type=float, default=0.5,
                         help="staleness-discount exponent: committed weight "
                              "= count * (1 + staleness) ** -alpha")
+    # compressed update transport (fedml_tpu.codecs): codec stage between
+    # the client step and the aggregator; "none" keeps the exact legacy
+    # (bit-identical) round program
+    parser.add_argument("--update_codec", type=str, default="none",
+                        choices=["none", "int8", "topk"],
+                        help="update transport codec: int8 quantization "
+                             "with error feedback, or top-k sparsification "
+                             "with static-shape payloads")
+    parser.add_argument("--codec_k", type=int, default=64,
+                        help="top-k codec: entries kept per leaf (clamped "
+                             "to the leaf size)")
+    parser.add_argument("--codec_bits", type=int, default=8,
+                        help="int8 codec: quantization width in bits (2-8; "
+                             "wire dtype stays int8)")
     # graft-trace observability (fedml_tpu.telemetry): TRACE.jsonl is
     # always written to <run_dir>/TRACE.jsonl; these knobs add sinks
     parser.add_argument("--trace_summary", type=int, default=0,

@@ -60,6 +60,14 @@ def build_sharded_round_fn(
     local_update = build_local_update(trainer, cfg, pvary_axes=(axis,))
     n_dev = mesh.shape[axis]
 
+    # codec-wrapped aggregators carry per-slot error-feedback residual rows
+    # in state["codec"] — those rows align with the cohort axis, so they
+    # shard like the data while the inner state stays replicated. An
+    # unwrapped aggregator keeps the exact legacy P() spec (bit-identity).
+    from fedml_tpu.codecs.transport import CodecAggregator
+    st_spec = ({"agg": P(), "codec": P(axis)}
+               if isinstance(aggregator, CodecAggregator) else P())
+
     def shard_body(global_variables, agg_state, x, y, counts, rng,
                    participation=None):
         c_local = x.shape[0]
@@ -109,7 +117,7 @@ def build_sharded_round_fn(
 
     # stats rows stay client-sharded end to end: concatenating the device
     # shards under P(axis) reproduces the staged cohort order exactly
-    out_specs = (P(), P(), P()) + ((P(axis),) if collect_stats else ())
+    out_specs = (P(), st_spec, P()) + ((P(axis),) if collect_stats else ())
 
     def round_fn(global_variables, agg_state, x, y, counts, rng,
                  participation=None):
@@ -117,14 +125,14 @@ def build_sharded_round_fn(
             sharded = shard_map(
                 shard_body,
                 mesh=mesh,
-                in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+                in_specs=(P(), st_spec, P(axis), P(axis), P(axis), P()),
                 out_specs=out_specs,
             )
             return sharded(global_variables, agg_state, x, y, counts, rng)
         sharded = shard_map(
             shard_body,
             mesh=mesh,
-            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(), P(axis)),
+            in_specs=(P(), st_spec, P(axis), P(axis), P(axis), P(), P(axis)),
             out_specs=out_specs,
         )
         return sharded(global_variables, agg_state, x, y, counts, rng,
@@ -138,6 +146,7 @@ def build_sharded_buffer_fns(
     discount_fn,
     mesh: Mesh,
     axis: str = "clients",
+    codec=None,
 ) -> tuple:
     """The buffered-aggregation admit/commit programs with the K-row update
     buffer (and the stacked client-step result) sharded over mesh `axis` —
@@ -156,18 +165,28 @@ def build_sharded_buffer_fns(
     commit: staleness discount and quarantine run shard-local, then the
     aggregator's `sharded` rule reduces with param-sized psums. Equal to the
     vmap commit up to float summation order, same bar as
-    build_sharded_round_fn (tests/test_buffered.py)."""
+    build_sharded_round_fn (tests/test_buffered.py).
+
+    `codec` arms the compressed admit transport: the admit program gains a
+    trailing replicated `gv` argument (the delta base), and the owner's row
+    crosses the mesh as the codec's encoded payload — masked int8 psums or
+    top-k (values, idx) psums instead of the full-width f32 row. The buffer
+    stores DECODED f32 rows (storage is device-local; only the wire is
+    compressed), so the commit program is unchanged. The codec-on admit is
+    a different program with its own COMMS_BUDGET.json entry; `codec=None`
+    traces the exact legacy admit."""
     from fedml_tpu.algorithms.engine import LocalResult
 
     n_dev = mesh.shape[axis]
 
     def admit_body(buf, fill, stacked_vars, stacked_steps, stacked_metrics,
-                   counts, src, birth_round):
+                   counts, src, birth_round, gv=None):
         c_local = stacked_steps.shape[0]
         k_local = buf["steps"].shape[0]
         didx = jax.lax.axis_index(axis)
 
-        # fetch: the owner's row, everywhere (one param-sized masked psum)
+        # fetch: the owner's row, everywhere (one param-sized masked psum —
+        # or, codec-on, the encoded payload's masked psums)
         src_local = jnp.clip(src - didx * c_local, 0, c_local - 1)
         has_src = (src >= didx * c_local) & (src < (didx + 1) * c_local)
 
@@ -177,7 +196,23 @@ def build_sharded_buffer_fns(
             return jax.lax.psum(
                 jnp.where(has_src, row, jnp.zeros((), row.dtype)), axis)
 
-        row_vars = jax.tree.map(fetch, stacked_vars)
+        if codec is None:
+            row_vars = jax.tree.map(fetch, stacked_vars)
+        else:
+            from fedml_tpu.codecs.transport import masked_row_transport
+
+            def _inexact(l):
+                return jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+
+            row_local = jax.tree.map(
+                lambda s: jax.lax.dynamic_index_in_dim(
+                    s, src_local, 0, keepdims=False), stacked_vars)
+            delta = jax.tree.map(
+                lambda r, g: r - g if _inexact(r) else r, row_local, gv)
+            dec = masked_row_transport(codec, delta, axis, has_src)
+            row_vars = jax.tree.map(
+                lambda g, d, r: (g + d).astype(r.dtype)
+                if _inexact(r) else d, gv, dec, row_local)
         row_steps = fetch(stacked_steps)
         row_weight = fetch(counts).astype(jnp.float32)
         row_metrics = {k: fetch(v) for k, v in stacked_metrics.items()}
@@ -236,16 +271,17 @@ def build_sharded_buffer_fns(
                 "metrics": P(axis), "birth": P(axis)}
 
     def admit_fn(buf, fill, stacked_vars, stacked_steps, stacked_metrics,
-                 counts, src, birth_round):
+                 counts, src, birth_round, *gv):
+        # codec-on admits take a trailing replicated gv (the delta base)
         sharded = shard_map(
             admit_body,
             mesh=mesh,
             in_specs=(buf_spec, P(), P(axis), P(axis), P(axis), P(axis),
-                      P(), P()),
+                      P(), P()) + ((P(),) if gv else ()),
             out_specs=buf_spec,
         )
         return sharded(buf, fill, stacked_vars, stacked_steps,
-                       stacked_metrics, counts, src, birth_round)
+                       stacked_metrics, counts, src, birth_round, *gv)
 
     def commit_fn(global_variables, agg_state, buf, fill, commit_round, rng):
         sharded = shard_map(

@@ -253,6 +253,81 @@ def _slice_tree(tree, specs, tensor_shards: int, lead: int = 0):
                         is_leaf=lambda x: isinstance(x, PS))
 
 
+# ------------------------------------------------- codec transport (uplink
+# + downlink). The tensor round is the one program whose collectives ARE
+# the federation's wire traffic in both directions: the entry all_gather
+# broadcasts the model to the client-hosting devices, the clients-axis
+# reductions carry the updates back. A codec therefore compresses BOTH
+# legs — measured split on the tformer budget program: 1.85 MB of gather
+# (downlink) vs 0.47 MB of psum (uplink), so an uplink-only codec could
+# never reach the 4x wire shrink the COMMS budget pins.
+
+def _quantized_gather_tree(tree, specs, tensor_shards: int, levels: int):
+    """Codec downlink: each device int8-quantizes its local shard slice
+    (per-shard scale, deterministic rounding), the all_gather moves int8
+    payloads + a (tensor_shards,) f32 scale vector per leaf, and every
+    device dequantizes tile-wise. Replicated leaves move no gather bytes
+    and pass through exact."""
+    def gather(leaf, spec):
+        d = _tensor_dim(spec)
+        if d is None:
+            return leaf
+        amax = jnp.max(jnp.abs(leaf))
+        scale = jnp.where(amax > 0, amax / levels, jnp.ones((), leaf.dtype))
+        q = jnp.clip(jnp.round(leaf / scale), -levels, levels).astype(jnp.int8)
+        qg = jax.lax.all_gather(q, TENSOR_AXIS, axis=d, tiled=True)
+        sg = jax.lax.all_gather(scale, TENSOR_AXIS)  # (t_sz,) f32
+        size = leaf.shape[d]
+        shp = qg.shape
+        qt = qg.reshape(shp[:d] + (tensor_shards, size) + shp[d + 1:])
+        sshape = (1,) * d + (tensor_shards, 1) + (1,) * (len(shp) - d - 1)
+        dec = qt.astype(leaf.dtype) * sg.reshape(sshape)
+        return dec.reshape(shp)
+
+    return jax.tree.map(gather, tree, specs,
+                        is_leaf=lambda x: isinstance(x, PS))
+
+
+def _shifted_spec(spec, inexact: bool):
+    """Residual-leaf spec: leading per-device slot dim over CLIENT_AXIS,
+    trailing dims tensor-sharded like the gv leaf (passthrough leaves keep
+    only the slot dim)."""
+    d = _tensor_dim(spec)
+    if d is None or not inexact:
+        return PS(CLIENT_AXIS)
+    return PS(*((CLIENT_AXIS,) + (None,) * d + (TENSOR_AXIS,)))
+
+
+def codec_residual_specs(specs_gv, global_variables):
+    """PartitionSpecs for the tensor round's uplink residual tree."""
+    return jax.tree.map(
+        lambda s, l: _shifted_spec(s, jnp.issubdtype(l.dtype, jnp.inexact)),
+        specs_gv, global_variables, is_leaf=lambda x: isinstance(x, PS))
+
+
+def init_codec_agg_state(sharding: "TensorSharding", global_variables,
+                         inner_state):
+    """Placed {"agg", "codec"} state for a codec-on tensor round: the inner
+    aggregator state tensor-sharded as usual, plus the per-device
+    error-feedback residual (zeros, one slot per clients-axis device,
+    trailing dims sharded like gv). Donated with the rest of the state."""
+    n_cl = sharding.mesh.shape[CLIENT_AXIS]
+    resid = jax.tree.map(
+        lambda l: jnp.zeros(
+            (n_cl,) + (l.shape if jnp.issubdtype(l.dtype, jnp.inexact)
+                       else ()), l.dtype),
+        global_variables)
+    specs_gv = sharding.specs(global_variables)
+    rspecs = codec_residual_specs(specs_gv, global_variables)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(sharding.mesh, s), rspecs,
+        is_leaf=lambda s: isinstance(s, PS))
+    return {
+        "agg": sharding.place(inner_state),
+        "codec": jax.device_put(resid, shardings),
+    }
+
+
 def _add_noise_sharded(aggregator, avg_shard, rng, full_params, specs_params,
                        tensor_shards: int):
     """RobustAggregator._add_noise with the SAME full-shape normal draws as
@@ -310,7 +385,8 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                           sharding: TensorSharding,
                           donate_state: bool = True,
                           donate_data: bool = False,
-                          collect_stats: bool = False) -> Callable:
+                          collect_stats: bool = False,
+                          codec=None) -> Callable:
     """Jitted tensor-sharded round over sharding.mesh — the runtime the
     rule tables exist for.
 
@@ -337,6 +413,21 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
     n_cl = mesh.shape[CLIENT_AXIS]
     t_sz = mesh.shape[TENSOR_AXIS]
     local_update = build_local_update(trainer, cfg, pvary_axes=(CLIENT_AXIS,))
+
+    if codec is not None:
+        from fedml_tpu.algorithms.aggregators import (FedAvgAggregator,
+                                                      FedOptAggregator)
+        if not isinstance(aggregator, (FedAvgAggregator, FedOptAggregator)):
+            raise ValueError(
+                "update codecs on the tensor path support fedavg/fedopt "
+                "only: robust clips whole-tree norms of raw client deltas "
+                "and fednova recombines per-client taus — both would "
+                "silently run on already-decoded values. Got %r"
+                % type(aggregator).__name__)
+        # downlink grid: reuse the int8 codec's level count; top-k has no
+        # scalar grid of its own, so its downlink rides the full int8 one
+        down_levels = codec.levels if codec.kind == "int8" else 127
+        is_fedopt = isinstance(aggregator, FedOptAggregator)
 
     def specialize(specs_gv, specs_st, masked: bool):
         def shard_body(gv_shard, st_shard, x, y, counts, rng,
@@ -382,6 +473,81 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                 return new_gshard, new_st, metrics, stats
             return new_gshard, new_st, metrics
 
+        def shard_body_codec(gv_shard, st_shard, x, y, counts, rng,
+                             participation=None):
+            """Codec-on twin of shard_body: int8 downlink on the entry
+            gather, codec uplink (transport_wsum) on the clients-axis
+            reduction of locally-weighted delta partial sums, device-
+            resident error-feedback residual in st_shard["codec"]."""
+            from fedml_tpu.codecs.transport import transport_wsum
+
+            inner_st = st_shard["agg"]
+            resid = st_shard["codec"]
+            c_local = x.shape[0]
+            didx = jax.lax.axis_index(CLIENT_AXIS)
+            all_keys = jax.random.split(rng, c_local * n_cl)
+            crngs = jax.lax.dynamic_slice_in_dim(all_keys, didx * c_local,
+                                                 c_local)
+            gv_full = _quantized_gather_tree(gv_shard, specs_gv, t_sz,
+                                             down_levels)
+            result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+                gv_full, x, y, counts, crngs)
+            stats = cohort_stats(gv_full, result) if collect_stats else None
+            weights = counts.astype(jnp.float32)
+            if participation is not None:
+                result, weights, alive, quarantined = quarantine_stage(
+                    result, weights, participation)
+            vars_shard = _slice_tree(result.variables, specs_gv, t_sz,
+                                     lead=1)
+
+            # local numerator partials: sum_i w_i * (vars_i - gv) for
+            # inexact leaves (deltas are what the codec encodes — small,
+            # zero-centered), plain weighted sums for passthrough leaves
+            def local_partial(l, g):
+                wb = weights.reshape((-1,) + (1,) * (l.ndim - 1))
+                if jnp.issubdtype(l.dtype, jnp.inexact):
+                    return jnp.sum((l - g[None]) * wb.astype(l.dtype),
+                                   axis=0)
+                return jnp.sum(l * wb.astype(l.dtype), axis=0)
+
+            wsum = jax.tree.map(local_partial, vars_shard, gv_shard)
+            r0 = jax.tree.map(lambda r: r[0], resid)
+            num, r_new = transport_wsum(codec, wsum, r0, CLIENT_AXIS, n_cl)
+            den = jax.lax.psum(weights.sum(), CLIENT_AXIS)
+            inv = 1.0 / jnp.maximum(den, 1e-12)
+            avg = jax.tree.map(
+                lambda g, s: (g + s * jnp.asarray(inv, s.dtype)).astype(
+                    g.dtype)
+                if jnp.issubdtype(g.dtype, jnp.inexact)
+                else (s * inv).astype(g.dtype),
+                gv_shard, num)
+            if is_fedopt:
+                new_gshard, new_inner = aggregator._server_step(
+                    gv_shard, avg, inner_st)
+            else:
+                new_gshard, new_inner = avg, inner_st
+            new_st = {"agg": new_inner,
+                      "codec": jax.tree.map(lambda r: r[None], r_new)}
+            metrics = {k: jax.lax.psum(v.sum(), CLIENT_AXIS)
+                       for k, v in result.metrics.items()}
+            if participation is None:
+                if collect_stats:
+                    return new_gshard, new_st, metrics, stats
+                return new_gshard, new_st, metrics
+            alive_total = jax.lax.psum(alive.sum(), CLIENT_AXIS)
+            any_alive = alive_total > 0
+            new_gshard = tree_where(any_alive, new_gshard, gv_shard)
+            # the all-dead revert covers the residual carry too: a round
+            # that commits nothing must not mutate the error feedback
+            new_st = tree_where(any_alive, new_st, st_shard)
+            metrics["participated_count"] = alive_total.astype(jnp.float32)
+            metrics["quarantined_count"] = jax.lax.psum(
+                quarantined.sum(), CLIENT_AXIS).astype(jnp.float32)
+            if collect_stats:
+                return new_gshard, new_st, metrics, stats
+            return new_gshard, new_st, metrics
+
+        body = shard_body if codec is None else shard_body_codec
         data_specs = (PS(CLIENT_AXIS), PS(CLIENT_AXIS), PS(CLIENT_AXIS))
         in_specs = (specs_gv, specs_st) + data_specs + (PS(),)
         if masked:
@@ -389,7 +555,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
         out_specs = (specs_gv, specs_st, PS())
         if collect_stats:
             out_specs = out_specs + (PS(CLIENT_AXIS),)
-        fn = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
         donate: Tuple[int, ...] = ()
         if donate_state:
@@ -409,7 +575,17 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
         jitted = cache.get(key)
         if jitted is None:
             specs_gv = sharding.specs(global_variables)
-            specs_st = sharding.specs(agg_state)
+            if codec is not None:
+                # wrapped {"agg", "codec"} state (init_codec_agg_state):
+                # inner state sharded as usual, residual rows on the
+                # shifted (CLIENT_AXIS, ..., TENSOR_AXIS) layout
+                specs_st = {
+                    "agg": sharding.specs(agg_state["agg"]),
+                    "codec": codec_residual_specs(specs_gv,
+                                                  global_variables),
+                }
+            else:
+                specs_st = sharding.specs(agg_state)
             jitted = specialize(specs_gv, specs_st, masked)
             cache[key] = jitted
         return jitted
@@ -440,5 +616,6 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
     from fedml_tpu import telemetry
     telemetry.emit("round_fn_built", program="tensor.round",
                    donate=donate_state,
-                   mesh=f"{n_cl}x{t_sz}")
+                   mesh=f"{n_cl}x{t_sz}",
+                   codec=(codec.name if codec is not None else "none"))
     return round_fn

@@ -62,6 +62,19 @@ class JobDescriptor:
         return "buffered" if self.config.buffer_size > 0 else "sync"
 
     @property
+    def codec(self) -> str:
+        """This tenant's update-codec name ("none" when transport is raw).
+
+        Per-tenant compression rides `config.update_codec` into the job's
+        own FedAvgAPI, so one scheduler can interleave a codec-on tenant
+        with codec-off ones — each tenant's admit/round programs (and their
+        COMPILE/COMMS budget accounting) stay per-job, and a codec-on
+        tenant served next to raw tenants trains byte-identical to the same
+        job solo (the serving bit-reproducibility argument is per-job
+        state, which the codec residual is part of)."""
+        return self.config.update_codec or "none"
+
+    @property
     def drive(self) -> str:
         """Which COMPILE_BUDGET.json drive this tenant's jit programs are
         accounted against (per-tenant compile-budget gate)."""

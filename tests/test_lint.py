@@ -802,6 +802,60 @@ def test_match_partition_rules_total_on_standard_names():
             [], {"params": {"kernel": jax.ShapeDtypeStruct((3, 4), jnp.float32)}})
 
 
+# -------------------------------------------------------- unregistered-codec
+
+def _codec_findings(src, path="fedml_tpu/algorithms/fixture.py"):
+    return [f for f in lint_source(src, path)
+            if f.rule == "unregistered-codec"]
+
+
+def test_unregistered_codec_fires_on_direct_int8_ctor():
+    src = ("from fedml_tpu.codecs import Int8Codec\n"
+           "def build(agg):\n"
+           "    return Int8Codec(bits=4)\n")
+    fs = _codec_findings(src)
+    assert len(fs) == 1
+    assert "make_codec" in fs[0].message
+
+
+def test_unregistered_codec_fires_on_dotted_topk_ctor():
+    src = ("from fedml_tpu import codecs\n"
+           "def build():\n"
+           "    return codecs.topk.TopKCodec(k=3)\n")
+    fs = _codec_findings(src, "fedml_tpu/parallel/fixture.py")
+    assert len(fs) == 1
+    assert "TopKCodec" in fs[0].message
+
+
+def test_unregistered_codec_make_codec_and_wrapper_are_clean():
+    src = ("from fedml_tpu.codecs import make_codec\n"
+           "from fedml_tpu.codecs.transport import CodecAggregator\n"
+           "def build(cfg, agg):\n"
+           "    codec = make_codec(cfg.update_codec, cfg)\n"
+           "    return CodecAggregator(codec, agg, slots=8)\n")
+    assert _codec_findings(src, "fedml_tpu/serving/fixture.py") == []
+
+
+def test_unregistered_codec_scoped_to_data_plane_paths():
+    src = ("from fedml_tpu.codecs import Int8Codec\n"
+           "c = Int8Codec(bits=8)\n")
+    # codecs/ itself and out-of-scope trees (analysis, tools, tests) are
+    # where direct construction is legitimate
+    for path in ("fedml_tpu/codecs/int8.py", "fedml_tpu/analysis/comms.py",
+                 "tools/bench_codec.py"):
+        assert _codec_findings(src, path) == []
+    assert _codec_findings(src, "fedml_tpu/algorithms/fixture.py")
+
+
+def test_unregistered_codec_suppression_works():
+    src = ("from fedml_tpu.codecs import TopKCodec\n"
+           "def build():\n"
+           "    # graft-lint: disable=unregistered-codec -- fixture codec "
+           "with a fixed k, never budget-pinned\n"
+           "    return TopKCodec(k=2)\n")
+    assert _codec_findings(src) == []
+
+
 # ----------------------------------------------------------------- repo clean
 
 def test_every_registered_model_has_an_example():

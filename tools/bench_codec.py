@@ -1,0 +1,149 @@
+"""Codec-on vs codec-off A/B: wire bytes per round and committed-updates/s.
+
+Two halves, one artifact (BENCH_CODEC_rNN.json):
+
+wire bytes   read from the committed COMMS_BUDGET.json — the measured
+             per-invocation collective bytes of each codec-on program twin
+             next to its codec-off twin (the same numbers the
+             `python -m fedml_tpu.analysis --comms` gate pins), reported
+             as bytes-per-round with the off/on shrink ratio. Budgets are
+             the source of truth on purpose: a bench re-measuring bytes
+             could drift from the gated values; this artifact can't.
+
+throughput   the buffered drive (mnist/lr, 16 clients, cohort 8, buffer 8)
+             run once per codec arm (off / int8 / topk) on the SAME seeded
+             workload, reporting committed client updates per wall-second.
+             On one host the codec costs a little encode/decode compute
+             and saves no wall time (the wire it shrinks is intra-host);
+             the number documents that overhead honestly — the byte
+             shrink, not rounds/s, is the headline.
+
+Env knobs:
+  BENCH_CODEC_ROUNDS=20                  dispatch rounds per throughput arm
+  BENCH_CODEC_OUT=BENCH_CODEC_r01.json   '' to skip the artifact
+
+The artifact's `parsed` block deliberately has NO top-level
+`rounds_per_sec` and no `arms["0"]`, and the perf gate skips BENCH_CODEC_*
+by name (telemetry/report.py _GATE_SKIP_PREFIXES) — a compression A/B is
+not a drive-throughput baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLIENTS, CPR, BATCH, BUFFER_K = 16, 8, 8, 8
+
+# codec-off program -> its codec-on twins in COMMS_BUDGET.json
+WIRE_PAIRS = {
+    "tensor.round[tformer,f32,fedavg,2x4]": (
+        "tensor.round[tformer,f32,fedavg,2x4,int8]",
+        "tensor.round[tformer,f32,fedavg,2x4,topk64]"),
+    "buffered.admit[lr,f32]": (
+        "buffered.admit[lr,f32,int8]",
+        "buffered.admit[lr,f32,topk16]"),
+}
+
+
+def wire_bytes_table(root: str) -> dict:
+    """Off-vs-on collective bytes per program pair, straight from the
+    committed comms budgets (collective_bytes = one invocation = one round for
+    tensor.round, one admit call for buffered.admit)."""
+    with open(os.path.join(root, "COMMS_BUDGET.json")) as f:
+        budgets = json.load(f)
+    table = {}
+    for off_name, on_names in WIRE_PAIRS.items():
+        off = budgets[off_name]["collective_bytes"]
+        row = {"off_bytes": off}
+        for on_name in on_names:
+            on = budgets[on_name]["collective_bytes"]
+            codec = on_name.rsplit(",", 1)[1].rstrip("]")
+            row[codec] = {"bytes": on, "shrink_x": round(off / on, 2)}
+        table[off_name] = row
+    return table
+
+
+def run_throughput_arm(ds, rounds: int, codec: str) -> dict:
+    """One buffered drive with the given update codec; committed-updates/s
+    over real wall time (drain included), mirroring tools/bench_buffered.py."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.registry import create_model
+
+    cfg = FedConfig(dataset="mnist", model="lr", comm_round=rounds,
+                    batch_size=BATCH, epochs=1, lr=0.05,
+                    client_num_in_total=CLIENTS, client_num_per_round=CPR,
+                    seed=0, ci=1, frequency_of_the_test=10**9,
+                    buffer_size=BUFFER_K, update_codec=codec)
+    trainer = ClassificationTrainer(
+        create_model("lr", output_dim=ds.class_num))
+    api = FedAvgAPI(ds, cfg, trainer)
+    t0 = time.perf_counter()
+    api.train()
+    wall_s = time.perf_counter() - t0
+    host = api._buffer_host
+    return {
+        "codec": codec,
+        "committed_updates": host.committed_updates,
+        "wall_s": round(wall_s, 4),
+        "committed_updates_per_sec": round(
+            host.committed_updates / wall_s, 2),
+    }
+
+
+def main() -> None:
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu.data.registry import load_dataset
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rounds = int(os.environ.get("BENCH_CODEC_ROUNDS", 20))
+    ds = load_dataset("mnist", client_num_in_total=CLIENTS,
+                      partition_method="homo", seed=0)
+
+    # warmup compiles every arm's programs outside the timed windows
+    for codec in ("none", "int8", "topk"):
+        run_throughput_arm(ds, 2, codec)
+    arms = {codec: run_throughput_arm(ds, rounds, codec)
+            for codec in ("none", "int8", "topk")}
+
+    cores = os.cpu_count() or 1
+    parsed = {
+        "metric": "codec_wire_bytes_and_committed_updates_per_sec",
+        "unit": "collective bytes per round (from COMMS_BUDGET.json) and "
+                "committed client updates per wall-second per codec arm",
+        "wire_bytes_per_round": wire_bytes_table(root),
+        "arms": arms,
+        "throughput_overhead_int8": round(
+            arms["none"]["committed_updates_per_sec"]
+            / max(arms["int8"]["committed_updates_per_sec"], 1e-9), 3),
+        "rounds": rounds, "clients": CLIENTS, "clients_per_round": CPR,
+        "batch_size": BATCH, "buffer_size": BUFFER_K, "model": "lr",
+        "platform": jax.devices()[0].platform,
+        "cpu_cores": cores,
+        "cpu_capped": cores < 2,
+    }
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_CODEC_OUT", "BENCH_CODEC_r01.json")
+    if out:
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": rounds,
+                       "cmd": "python tools/bench_codec.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
