@@ -96,6 +96,31 @@ assert "bytes" in finding.message, finding
 print("OK comms budget trips on codec-on admit regression:", finding.message)
 EOF
 
+echo "== step-peak comms self-test: a tightened tensor.step ceiling must trip"
+# the sharded step's peak_bytes budget (1.5x headroom over the measured
+# per-device peak) is doctored to a third of the committed ceiling — below
+# the measured peak — and the gate must fire on peak_bytes, proving the
+# activation-sharding memory contract is a live gate; the targeted run
+# also re-lowers the replicated twin, so the <=0.5x measured-ratio gate
+# runs (and must stay quiet) in the same pass
+python - <<'EOF'
+import json, tempfile, os
+from fedml_tpu.analysis.comms import run_comms
+name = "tensor.step[tformer,f32,2x4]"
+budgets = json.load(open("COMMS_BUDGET.json"))
+budgets[name]["peak_bytes"] //= 3
+with tempfile.TemporaryDirectory() as d:
+    with open(os.path.join(d, "COMMS_BUDGET.json"), "w") as f:
+        json.dump(budgets, f)
+    report, _ = run_comms(d, targets=[name])
+assert not report.ok, "tightened step peak budget failed to trip the gate"
+finding = next(f for f in report.findings
+               if f.rule == "comms-budget" and f.target == name)
+assert "peak_bytes" in finding.message, finding
+print("OK comms budget trips on tensor.step peak regression:",
+      finding.message)
+EOF
+
 echo "== graft-lint compile layer (retrace budgets vs COMPILE_BUDGET.json)"
 # enumerates every jit entry point reachable from each drive config and
 # pins the exact compiled-program counts, plus the AST retrace-risk /
@@ -193,6 +218,52 @@ python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
 assert_summary "Test/Loss" 0 10
 assert_summary "Test/Acc" 0.0 1.0
 assert_summary "quarantined_count" 1 7
+
+echo "== federated LoRA smoke (--lora_rank 8: adapter-only rounds, CLI level)"
+# two rounds with rank-8 adapters on the lr base — the CLI seam wraps the
+# trainer via maybe_wrap_lora, the drive trains (A,B) only, and the loss
+# must stay finite and the accuracy sane
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 2 \
+  --epochs 1 --batch_size 4 --lora_rank 8
+assert_summary "Test/Loss" 0 10
+assert_summary "Test/Acc" 0.0 1.0
+
+echo "== LoRA frozen-base check: the same drive must never move the base"
+python - <<'EOF'
+# API-level twin of the CLI smoke: the base params live in the lora_base
+# collection and must be byte-identical after training, while the adapters
+# (the only federated state) must have moved
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.lora import LORA_COLLECTION, maybe_wrap_lora
+from fedml_tpu.models.registry import create_model
+
+ds = load_dataset("mnist", client_num_in_total=8, partition_method="homo")
+cfg = FedConfig(comm_round=2, epochs=1, batch_size=4, lr=0.05,
+                client_num_in_total=8, client_num_per_round=8, lora_rank=8)
+trainer = maybe_wrap_lora(
+    ClassificationTrainer(create_model("lr", output_dim=10)), cfg)
+api = FedAvgAPI(ds, cfg, trainer)
+base0 = jax.tree.map(np.copy, api.global_variables[LORA_COLLECTION])
+adap0 = jax.tree.map(np.copy, api.global_variables["params"])
+api.train()
+for x, y in zip(jax.tree.leaves(base0),
+                jax.tree.leaves(api.global_variables[LORA_COLLECTION])):
+    assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), \
+        "frozen LoRA base moved during federated rounds"
+moved = any(not np.array_equal(x, np.asarray(y)) for x, y in
+            zip(jax.tree.leaves(adap0),
+                jax.tree.leaves(api.global_variables["params"])))
+assert moved, "adapters never moved — the drive trained nothing"
+print("OK LoRA drive: base byte-frozen, adapters trained")
+EOF
 
 echo "== graft-trace smoke (depth-2 chaos drive: --trace_summary + span coverage)"
 # same chaos workload, pipelined, with the tracer's p50/p95 table on stdout;

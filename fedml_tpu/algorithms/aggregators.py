@@ -474,6 +474,7 @@ def build_buffer_commit(aggregator, discount_fn):
     # LocalResult lives in engine; the import is lazy for the same
     # engine<->aggregators cycle make_server_optimizer documents
     from fedml_tpu.algorithms.engine import LocalResult
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
 
     def commit(global_variables, agg_state, buf, commit_round, rng):
         k = buf["weights"].shape[0]
@@ -487,8 +488,13 @@ def build_buffer_commit(aggregator, discount_fn):
         new_global, new_state = aggregator(
             global_variables, result, weights, rng, agg_state)
         any_alive = jnp.any(alive)
-        new_global = tree_where(any_alive, new_global, global_variables)
+        # LoRA: buffer rows (and hence the aggregator output) are
+        # adapters-only; the all-dead fallback must match that structure,
+        # the server's frozen base re-attaches after (engine.py idiom)
+        new_global = tree_where(any_alive, new_global,
+                                strip_lora_base(global_variables))
         new_state = tree_where(any_alive, new_state, agg_state)
+        new_global = attach_lora_base(new_global, global_variables)
         metrics = {name: v.sum() for name, v in result.metrics.items()}
         metrics["participated_count"] = alive.sum().astype(jnp.float32)
         metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)

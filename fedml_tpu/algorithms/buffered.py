@@ -278,8 +278,12 @@ class BufferedRunner:
                 if self.codec is not None:
                     # codec-on admit decodes the row's delta against the
                     # CURRENT globals — the same reference the commit's
-                    # aggregation applies it to
-                    args = args + (api.global_variables,)
+                    # aggregation applies it to. Base-stripped: buffer rows
+                    # are adapters-only under LoRA (engine strips inside
+                    # the vmap) and the delta reference must match them.
+                    from fedml_tpu.models.lora import strip_lora_base
+
+                    args = args + (strip_lora_base(api.global_variables),)
                 api._buffer = self.admit_fn(*args)
             host.fill += 1
             self.in_flight -= 1

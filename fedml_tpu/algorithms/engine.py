@@ -296,6 +296,12 @@ def build_local_update(trainer, cfg: FedConfig, pvary_axes: tuple = ()) -> Calla
         )
         # summed train metrics from the final local epoch (shape [E, nb] -> last epoch)
         metrics = {k: v[-1].sum() for k, v in auxs.items()}
+        # federated LoRA (models/lora.py): the frozen base never trains, so
+        # it leaves the client update HERE — inside the vmapped function —
+        # and the cohort-stacked result tree never materializes C copies of
+        # it. Aggregation, codecs, buffers and the wire all see
+        # adapters-only trees; the round fn re-attaches the server's base.
+        variables = {k: v for k, v in variables.items() if k != "lora_base"}
         return LocalResult(variables, steps, metrics)
 
     return local_update
@@ -380,6 +386,7 @@ def build_round_fn_from_update(batched_update, aggregator,
     # engine.torch_adagrad, so the modules must not need each other at
     # import time
     from fedml_tpu.algorithms.aggregators import quarantine_stage
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
 
     def round_fn(global_variables, agg_state, x, y, counts, rng,
                  participation=None):
@@ -394,6 +401,10 @@ def build_round_fn_from_update(batched_update, aggregator,
             new_global, new_state = aggregator(
                 global_variables, result, weights, rng, agg_state
             )
+            # LoRA: aggregation ran adapters-only (results are stripped);
+            # the server's frozen base re-attaches untouched (no-op when
+            # the trainer isn't wrapped)
+            new_global = attach_lora_base(new_global, global_variables)
             # per-client metric sums -> federation totals
             metrics = {k: v.sum() for k, v in result.metrics.items()}
             if collect_stats:
@@ -405,8 +416,12 @@ def build_round_fn_from_update(batched_update, aggregator,
             global_variables, result, weights, rng, agg_state
         )
         any_alive = jnp.any(alive)
-        new_global = tree_where(any_alive, new_global, global_variables)
+        # the all-dead fallback must match the aggregator output's
+        # (adapters-only under LoRA) structure; base re-attaches after
+        new_global = tree_where(any_alive, new_global,
+                                strip_lora_base(global_variables))
         new_state = tree_where(any_alive, new_state, agg_state)
+        new_global = attach_lora_base(new_global, global_variables)
         metrics = {k: v.sum() for k, v in result.metrics.items()}
         metrics["participated_count"] = alive.sum().astype(jnp.float32)
         metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
@@ -468,7 +483,87 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
     (and an unwrapped aggregator) traces the exact legacy program —
     codec-off rounds stay bit-identical.
     """
+    if getattr(cfg, "fused_kernel", False):
+        # ROADMAP item 1a: route the epoch through the fused pallas SGD
+        # kernel (ops/fused_sgd.py). The kernel IS the model+optimizer
+        # program, so every knob it cannot honor is rejected loudly here
+        # instead of silently diverging from the engine trajectory.
+        if param_sharding is not None or cfg.tensor_shards > 0:
+            raise ValueError(
+                "--fused_kernel is mutually exclusive with --tensor_shards "
+                "(the kernel owns the whole client step)")
+        if codec is not None or cfg.update_codec != "none":
+            raise ValueError(
+                "--fused_kernel is mutually exclusive with --update_codec")
+        if cfg.buffer_size > 0:
+            raise ValueError(
+                "--fused_kernel is mutually exclusive with --buffer_size "
+                "(buffered admission consumes per-client LocalResults)")
+        if getattr(cfg, "lora_rank", 0) > 0:
+            raise ValueError(
+                "--fused_kernel is mutually exclusive with --lora_rank "
+                "(the kernel trains the raw CNN param layout)")
+        if (cfg.client_optimizer != "sgd" or cfg.momentum or cfg.wd
+                or cfg.fedprox_mu):
+            raise ValueError(
+                "the fused kernel implements plain SGD with global-norm "
+                "clip — sgd, momentum 0, wd 0, fedprox_mu 0 required")
+        if cfg.epochs != 1:
+            raise ValueError("the fused kernel runs exactly one local epoch")
+        if cfg.grad_clip is None:
+            raise ValueError(
+                "the fused kernel clips unconditionally (reference "
+                "semantics) — grad_clip must be set")
+        if type(trainer.module).__name__ != "CNN_DropOut":
+            raise ValueError(
+                "--fused_kernel supports the femnist CNN_DropOut model only")
+        from fedml_tpu.ops.fused_sgd import (FusedEpochSpec,
+                                             build_fused_round_fn)
+
+        # CPU runs the kernel in pallas interpret mode: correctness-honest,
+        # no speed claim (tools/bench_fused.py) — the Mosaic path needs a
+        # real TPU backend
+        interpret = jax.default_backend() != "tpu"
+        n_classes = int(getattr(trainer.module, "output_dim", 62))
+        compute_dtype = (jnp.bfloat16 if cfg.dtype == "bfloat16"
+                         else jnp.float32)
+        _specialized: dict = {}
+
+        def fused_round(gv, agg_state, x, y, counts, rng, *rest):
+            # per-client sample count is data geometry, not config — build
+            # the spec (and jit) once per cohort shape, like the engine's
+            # own shape-keyed retraces
+            key = tuple(x.shape)
+            if key not in _specialized:
+                spec = FusedEpochSpec(
+                    height=int(x.shape[2]), width=int(x.shape[3]),
+                    n_classes=n_classes, samples=int(x.shape[1]),
+                    batch=cfg.batch_size, lr=cfg.lr,
+                    grad_clip=cfg.grad_clip, compute_dtype=compute_dtype,
+                    # mirror the module's own rates — a drop-free CNN twin
+                    # (bench_fused's allclose arm) must stay drop-free fused
+                    drop1=float(getattr(trainer.module, "drop1", 0.25)),
+                    drop2=float(getattr(trainer.module, "drop2", 0.5)))
+                _specialized[key] = build_fused_round_fn(
+                    spec, aggregator, shuffle=cfg.shuffle,
+                    interpret=interpret, collect_stats=collect_stats)
+            return _specialized[key](gv, agg_state, x, y, counts, rng, *rest)
+
+        from fedml_tpu import telemetry
+        telemetry.emit("round_fn_built", program="engine.round[fused]",
+                       donate=False)
+        return fused_round
     if param_sharding is not None:
+        if getattr(cfg, "shard_step", False):
+            # activation-sharded client step (GSPMD) — allclose contract,
+            # per-device peak-bytes shrink; parallel/tensor.py docs
+            from fedml_tpu.parallel.tensor import build_tensor_step_round_fn
+
+            return build_tensor_step_round_fn(
+                trainer, cfg, aggregator, param_sharding,
+                donate_state=bool(cfg.extra.get("donate_params", False)),
+                donate_data=donate_data, collect_stats=collect_stats,
+                codec=codec)
         from fedml_tpu.parallel.tensor import build_tensor_round_fn
 
         return build_tensor_round_fn(

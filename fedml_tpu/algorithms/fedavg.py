@@ -666,7 +666,14 @@ class FedAvgAPI(Checkpointable):
     # aggregator state + history (SURVEY §5: the reference's core FedAvg
     # cannot resume; this can)
     def _ckpt_tree(self):
-        return {"variables": self.global_variables, "agg_state": self.agg_state}
+        # LoRA: checkpoints persist adapters-only. The frozen base is a
+        # pure function of cfg.seed (trainer.init), so storing it would
+        # multiply checkpoint bytes by ~the model size for zero
+        # information; resume/rollback re-attach the live base below.
+        from fedml_tpu.models.lora import strip_lora_base
+
+        return {"variables": strip_lora_base(self.global_variables),
+                "agg_state": self.agg_state}
 
     def _ckpt_meta(self):
         # copy: the snapshot must not alias the live list a later flush
@@ -674,7 +681,13 @@ class FedAvgAPI(Checkpointable):
         return {"history": list(self.history)}
 
     def _ckpt_load(self, tree, meta):
-        self.global_variables = tree["variables"]
+        from fedml_tpu.models.lora import attach_lora_base
+
+        # re-attach the deterministic frozen base from the live state (a
+        # no-op when the trainer isn't LoRA-wrapped): guard rollback and
+        # resume both restore adapters + agg state, never the base
+        self.global_variables = attach_lora_base(tree["variables"],
+                                                 self.global_variables)
         self.agg_state = tree["agg_state"]
         # in place: the drive loop's RoundRecordLog holds this list — a
         # rebind here would strand its post-rollback flushes on a stale copy

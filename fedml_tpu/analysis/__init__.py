@@ -44,6 +44,7 @@ from fedml_tpu.analysis.jaxpr_engine import (
     check_dtype_policy,
     check_host_sync,
     check_retrace,
+    check_unconstrained_intermediate,
     lint_jaxpr,
     walk_eqns,
 )
@@ -80,6 +81,7 @@ __all__ = [
     "check_dead_cast",
     "check_donation",
     "check_retrace",
+    "check_unconstrained_intermediate",
     "lint_source",
     "lint_tree",
     "lint_compile_source",

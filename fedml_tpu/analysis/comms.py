@@ -2,11 +2,13 @@
 
 `PROGRAMS` names the repo's parallel round programs — the shard_map
 rounds (sharded.py's round per aggregator, hierarchical.py's two-axis
-round, the four 2x4 tensor-sharded rounds of parallel/tensor.py,
-gossip.py's ring mix, both sequence.py attention variants) plus two
-single-chip extras (the engine round and the chunked chunk_fn) whose budget
-entries pin their collective count at ZERO: a collective ever appearing in
-the single-chip path is itself the regression. `--fast` skips the extras.
+round, the 2x4 tensor-sharded rounds of parallel/tensor.py with their
+codec and federated-LoRA twins, the GSPMD `tensor.step` activation-sharded
+client step and its replicated budget twin, gossip.py's ring mix, both
+sequence.py attention variants) plus two single-chip extras (the engine
+round and the chunked chunk_fn) whose budget entries pin their collective
+count at ZERO: a collective ever appearing in the single-chip path is
+itself the regression. `--fast` skips the extras.
 
 Every program lowers on the forced 8-virtual-device host mesh
 (``--xla_force_host_platform_device_count=8``, set by the CLI before
@@ -166,7 +168,8 @@ def _ulysses_attention():
 
 
 def _tensor_round(model_name: str, agg_name: str,
-                  codec_name: Optional[str] = None, codec_k: int = 64):
+                  codec_name: Optional[str] = None, codec_k: int = 64,
+                  lora_rank: int = 0):
     """A 2x4 ('clients', 'tensor') tensor-sharded round
     (parallel/tensor.py): params + aggregator state enter sharded, the
     round gathers per leaf at entry and slices before the client psums —
@@ -179,7 +182,15 @@ def _tensor_round(model_name: str, agg_name: str,
     top-k (values, idx) all_gathers). Its COMMS entry is the headline
     wire-shrink gate — the top-k variant must show >=4x fewer collective
     bytes than the codec-off twin (tests/test_codecs.py pins the ratio
-    from the committed budgets)."""
+    from the committed budgets).
+
+    `lora_rank` builds the federated-LoRA twin (models/lora.py): the
+    trainer is LoRA-wrapped, so the federated tree is adapters-only and
+    the entry's exact `param_bytes` pin is the >=50x wire-shrink gate vs
+    the full-model twin (tests/test_lora.py reads both from the committed
+    budgets). Codecs then compress the adapter deltas — the lora+topk
+    entry must move strictly fewer collective bytes than either lever
+    alone (gated in run_comms on the measured programs)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -187,6 +198,7 @@ def _tensor_round(model_name: str, agg_name: str,
     from fedml_tpu.algorithms.aggregators import make_aggregator
     from fedml_tpu.codecs import make_codec
     from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.models.lora import LoRATrainer, strip_lora_base
     from fedml_tpu.parallel.tensor import (TensorSharding,
                                            build_tensor_round_fn)
 
@@ -194,20 +206,28 @@ def _tensor_round(model_name: str, agg_name: str,
                 ("clients", "tensor"))
     cfg = FedConfig(model=model_name, batch_size=2, epochs=1,
                     dtype="float32", server_optimizer="adam", server_lr=0.01,
-                    update_codec=codec_name or "none", codec_k=codec_k)
+                    update_codec=codec_name or "none", codec_k=codec_k,
+                    lora_rank=lora_rank)
     if model_name == "lr":
         trainer = _lr_trainer()
-        gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+        in_shape, in_dtype = (2, 32), jnp.float32
         data = (jax.ShapeDtypeStruct((2, 4, 32), jnp.float32),
                 jax.ShapeDtypeStruct((2, 4), jnp.int32))
     else:
         from fedml_tpu.core.trainer import NWPTrainer
         from fedml_tpu.models.registry import create_model
 
-        trainer = NWPTrainer(create_model(model_name, output_dim=10))
-        gv, rng = _abstract_gv(trainer, (2, 16), jnp.int32)
+        # realistic NWP vocab (the registry default): the embedding + LM
+        # head dominate the param tree exactly as they do in the deployed
+        # stackoverflow-scale models, so the LoRA twins' >=50x param_bytes
+        # shrink is measured against an honest full-model baseline
+        trainer = NWPTrainer(create_model(model_name, output_dim=10004))
+        in_shape, in_dtype = (2, 16), jnp.int32
         data = (jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
                 jax.ShapeDtypeStruct((2, 4, 16), jnp.int32))
+    if lora_rank:
+        trainer = LoRATrainer(trainer, rank=lora_rank)
+    gv, rng = _abstract_gv(trainer, in_shape, in_dtype)
     agg = make_aggregator(agg_name, cfg)
     codec = make_codec(cfg.update_codec, cfg)
     round_fn = build_tensor_round_fn(
@@ -217,17 +237,67 @@ def _tensor_round(model_name: str, agg_name: str,
         agg_state = jax.eval_shape(agg.init_state, gv)
     else:
         def init_st(g):
+            # the residual mirrors the WIRE tree — adapters-only under LoRA
+            fed = strip_lora_base(g)
             resid = jax.tree.map(
                 lambda l: jnp.zeros(
                     (2,) + (l.shape
                             if jnp.issubdtype(l.dtype, jnp.inexact)
-                            else ()), l.dtype), g)
+                            else ()), l.dtype), fed)
             return {"agg": agg.init_state(g), "codec": resid}
 
         agg_state = jax.eval_shape(init_st, gv)
     args = (gv, agg_state) + data + (
         jax.ShapeDtypeStruct((2,), jnp.int32), rng)
-    return round_fn, args, _tree_bytes(gv)
+    # 4th element: the federated (wire) tree's bytes — the exact
+    # `param_bytes` pin. Equal to the full tree when LoRA is off.
+    return round_fn, args, _tree_bytes(gv), _tree_bytes(strip_lora_base(gv))
+
+
+def _tensor_step(replicated: bool = False):
+    """The activation-sharded client step (parallel/tensor.py
+    build_tensor_step_fn) on the 2x4 ('clients', 'tensor') mesh — the
+    program whose per-device peak bytes IS the tentpole win. Params enter
+    under the transformer rule table and the matmul/attention
+    intermediates carry sharding constraints, so neither the weights nor
+    the activations ever materialize whole on one device.
+
+    `replicated=True` builds the budget twin: same step, same mesh, same
+    data sharding, but params replicated and the activation-constraint
+    scope off — the baseline the <=0.5x per-device peak ratio is measured
+    against (gated in run_comms; tests/test_lora.py re-derives it from
+    memory_analysis directly). Both entries pin collective traffic at
+    ZERO: the step is client-parallel + tensor-sharded compute with no
+    cross-device reduction until aggregation, so any collective appearing
+    here is itself the regression."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import NWPTrainer
+    from fedml_tpu.models.registry import create_model
+    from fedml_tpu.parallel.tensor import (REPLICATED_RULES, TensorSharding,
+                                           build_tensor_step_fn)
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(2, 4),
+                ("clients", "tensor"))
+    cfg = FedConfig(model="transformer_nwp", batch_size=2, epochs=1,
+                    dtype="float32", tensor_shards=4)
+    trainer = NWPTrainer(create_model("transformer_nwp", output_dim=10004))
+    if replicated:
+        sharding = TensorSharding(mesh, tuple(REPLICATED_RULES))
+        step_fn = build_tensor_step_fn(trainer, cfg, sharding,
+                                       activation_rules=None)
+    else:
+        sharding = TensorSharding.for_model(mesh, "transformer_nwp")
+        step_fn = build_tensor_step_fn(trainer, cfg, sharding)
+    gv, rng = _abstract_gv(trainer, (2, 16), jnp.int32)
+    args = (gv,
+            jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
+            jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.int32), rng)
+    return step_fn, args, _tree_bytes(gv), _tree_bytes(gv)
 
 
 def _buffered_program(which: str, agg_name: str,
@@ -357,6 +427,22 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
     "tensor.round[tformer,f32,fedavg,2x4,topk64]": (
         lambda: _tensor_round("transformer_nwp", "fedavg", "topk", 64),
         N_DEV),
+    # federated-LoRA twins (models/lora.py): the federated tree is the
+    # adapters-only view, so the exact param_bytes pin is the >=50x
+    # wire-shrink gate vs the full-model twin; lora+topk stacks both
+    # levers and must move strictly fewer bytes than either alone
+    "tensor.round[tformer,f32,fedavg,2x4,lora8]": (
+        lambda: _tensor_round("transformer_nwp", "fedavg", lora_rank=8),
+        N_DEV),
+    "tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]": (
+        lambda: _tensor_round("transformer_nwp", "fedavg", "topk", 64,
+                              lora_rank=8), N_DEV),
+    # the activation-sharded client step + its replicated budget twin —
+    # the pair behind the <=0.5x per-device peak-bytes gate below
+    "tensor.step[tformer,f32,2x4]": (
+        lambda: _tensor_step(replicated=False), N_DEV),
+    "tensor.step[tformer,f32,2x4,replicated]": (
+        lambda: _tensor_step(replicated=True), N_DEV),
     "buffered.admit[lr,f32]": (
         lambda: _buffered_program("admit", "fedavg"), N_DEV),
     "buffered.admit[lr,f32,int8]": (
@@ -377,7 +463,22 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
 EXTRA_PROGRAMS = ("engine.round[lr,f32,fedavg]",
                   "engine.chunked.chunk_fn[lr]")
 
-_BUDGET_KEYS = ("collective_count", "collective_bytes", "peak_bytes")
+_BUDGET_KEYS = ("collective_count", "collective_bytes", "peak_bytes",
+                "param_bytes")
+
+# measured-ratio gates applied in run_comms whenever both programs of a
+# pair were analyzed in the same run (targets filtering may select one):
+# the sharded tensor.step must keep per-device peak at <=0.5x its
+# replicated twin — the activation-sharding win IS the program's reason
+# to exist, so losing it is a finding, not a budget bump.
+_STEP_PEAK_GATE = ("tensor.step[tformer,f32,2x4]",
+                   "tensor.step[tformer,f32,2x4,replicated]", 0.5)
+
+# lora+topk must move strictly fewer collective bytes than either lever
+# alone — the codecs compress adapter deltas, so the wire shrinks stack
+_LORA_STACK_GATE = ("tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]",
+                    ("tensor.round[tformer,f32,fedavg,2x4,lora8]",
+                     "tensor.round[tformer,f32,fedavg,2x4,topk64]"))
 
 
 def load_budgets(repo_root: str) -> Dict[str, Dict[str, int]]:
@@ -389,9 +490,15 @@ def load_budgets(repo_root: str) -> Dict[str, Dict[str, int]]:
 
 
 def make_budgets(programs: Dict[str, ProgramComms],
-                 existing: Optional[Dict] = None) -> Dict[str, Dict]:
+                 existing: Optional[Dict] = None,
+                 param_bytes: Optional[Dict[str, int]] = None
+                 ) -> Dict[str, Dict]:
     """Budget entries for measured programs, merged over `existing` so a
-    filtered --update-budgets run does not drop the rest of the table."""
+    filtered --update-budgets run does not drop the rest of the table.
+    `param_bytes` (per program, from the builders that report it) is
+    pinned EXACTLY — the federated tree's size is a deterministic function
+    of the model + LoRA rank, and the pin is what the >=50x adapter-only
+    wire-shrink test reads."""
     out = dict(existing or {})
     for name, pc in programs.items():
         entry = {
@@ -400,12 +507,17 @@ def make_budgets(programs: Dict[str, ProgramComms],
         }
         if pc.peak_bytes is not None:
             entry["peak_bytes"] = int(pc.peak_bytes * PEAK_HEADROOM)
+        pb = (param_bytes or {}).get(name)
+        if pb is not None:
+            entry["param_bytes"] = int(pb)
         out[name] = entry
     return dict(sorted(out.items()))
 
 
 def check_budgets(programs: Dict[str, ProgramComms],
-                  budgets: Dict[str, Dict]) -> List[Finding]:
+                  budgets: Dict[str, Dict],
+                  param_bytes: Optional[Dict[str, int]] = None
+                  ) -> List[Finding]:
     """Gate measured comms against the checked-in ceilings. The message is
     the diff a human needs: key, measured, ceiling, overshoot."""
     findings: List[Finding] = []
@@ -420,7 +532,8 @@ def check_budgets(programs: Dict[str, ProgramComms],
             continue
         measured = {"collective_count": pc.collective_count,
                     "collective_bytes": pc.collective_bytes,
-                    "peak_bytes": pc.peak_bytes}
+                    "peak_bytes": pc.peak_bytes,
+                    "param_bytes": (param_bytes or {}).get(name)}
         for key in _BUDGET_KEYS:
             ceiling = budget.get(key)
             got = measured[key]
@@ -454,27 +567,67 @@ def run_comms(repo_root: str, fast: bool = False,
 
     report = Report()
     programs: Dict[str, ProgramComms] = {}
+    param_bytes: Dict[str, int] = {}
     for name, (builder, num_devices) in PROGRAMS.items():
         if fast and name in EXTRA_PROGRAMS:
             continue
         if targets and not any(t in name for t in targets):
             continue
-        fn, args, params_bytes = builder()
+        built = builder()
+        fn, args, params_bytes = built[:3]
+        if len(built) > 3 and built[3] is not None:
+            # federated-tree bytes (builders that report them) — the
+            # exact param_bytes pin
+            param_bytes[name] = int(built[3])
         comms, findings = analyze_program(
             fn, args, name, num_devices=num_devices,
-            params_bytes=params_bytes, compile=compile_programs)
+            params_bytes=params_bytes, compile=compile_programs,
+            # tensor.step runs under GSPMD automatic partitioning — the
+            # partitioner's resharding collectives are by design there
+            expect_resharding=name.startswith("tensor.step"))
         report.extend(findings)
         report.mark(name)
         if comms is not None:
             programs[name] = comms
 
+    # measured-ratio gates (independent of the budget file — these hold
+    # whenever both programs of a pair were analyzed in this run)
+    sh_name, rep_name, ratio = _STEP_PEAK_GATE
+    sh, rep = programs.get(sh_name), programs.get(rep_name)
+    if (sh is not None and rep is not None
+            and sh.peak_bytes and rep.peak_bytes
+            and sh.peak_bytes > ratio * rep.peak_bytes):
+        report.extend([Finding(
+            "comms-budget", sh_name,
+            f"activation-sharded step peak {sh.peak_bytes}B exceeds "
+            f"{ratio}x its replicated twin ({rep.peak_bytes}B, ratio "
+            f"{sh.peak_bytes / rep.peak_bytes:.2f}) — the per-device "
+            f"memory shrink is the program's contract; a lost sharding "
+            f"constraint or a gather of the full params re-materializes "
+            f"the replicated footprint")])
+    stack_name, singles = _LORA_STACK_GATE
+    stacked = programs.get(stack_name)
+    for single_name in singles:
+        single = programs.get(single_name)
+        if (stacked is not None and single is not None
+                and stacked.collective_bytes >= single.collective_bytes):
+            report.extend([Finding(
+                "comms-budget", stack_name,
+                f"lora+topk moved {stacked.collective_bytes}B on the wire "
+                f"— not strictly fewer than {single_name} "
+                f"({single.collective_bytes}B); the codec must compress "
+                f"the adapter deltas, not the full tree (the shrinks are "
+                f"multiplicative by construction)")])
+
     if update_budgets:
-        budgets = make_budgets(programs, existing=load_budgets(repo_root))
+        budgets = make_budgets(programs, existing=load_budgets(repo_root),
+                               param_bytes=param_bytes)
         with open(os.path.join(repo_root, BUDGET_FILE), "w") as f:
             json.dump(budgets, f, indent=2)
             f.write("\n")
     else:
-        report.extend(check_budgets(programs, load_budgets(repo_root)))
+        report.extend(check_budgets(programs, load_budgets(repo_root),
+                                    param_bytes=param_bytes))
 
     comms_dict = {
         "ok": report.ok,

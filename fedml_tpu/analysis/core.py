@@ -30,6 +30,12 @@ RULES = {
                               "the blessed materialize() helper (stores are "
                               "O(cohort) by contract — select() the cohort)",
     "partition-coverage": "param tree leaf matches no PartitionSpec rule",
+    "unconstrained-intermediate": "matmul/einsum intermediates in a "
+                                  "tensor-sharded step carry no sharding "
+                                  "constraint — GSPMD will gather the "
+                                  "activations replicated between layers "
+                                  "and the per-device peak-memory win "
+                                  "silently evaporates",
     # HLO-layer rules (hlo_engine / comms): lowered-program collectives
     "collective-in-loop": "loop-invariant collective inside a while/scan body",
     "accidental-replication": "partitioner all-gather rematerializes the "

@@ -447,9 +447,18 @@ _OPT_ALL_GATHER_RE = re.compile(r"=\s+\S+\s+all-gather(?:-start)?\(")
 
 def check_accidental_replication(module: HloModule, target: str,
                                  params_bytes: Optional[int] = None,
-                                 optimized_text: Optional[str] = None
+                                 optimized_text: Optional[str] = None,
+                                 expect_resharding: bool = False
                                  ) -> List[Finding]:
     findings: List[Finding] = []
+    if expect_resharding:
+        # GSPMD programs (automatic partitioning, e.g. tensor.step): the
+        # partitioner inserting resharding collectives IS the mechanism,
+        # not an accident — the traced program pins the user-written
+        # collectives at zero and the peak-bytes budget bounds what the
+        # resharding may cost per device. The pre-opt full-tree gather
+        # check below still applies.
+        optimized_text = None
     pre_gathers = [inst for _, inst in module.all_instructions()
                    if inst.opcode in ("all-gather", "all-gather-start")]
     if params_bytes:
@@ -625,13 +634,19 @@ def summarize_inventory(inventory: List[Dict]
 
 def analyze_program(fn, args, target: str, *, num_devices: int,
                     params_bytes: Optional[int] = None,
-                    compile: bool = True
+                    compile: bool = True,
+                    expect_resharding: bool = False
                     ) -> Tuple[Optional[ProgramComms], List[Finding]]:
     """Lower one program, inventory its collectives, run every HLO rule.
 
     Returns (ProgramComms or None, findings). An "unbound axis name" error
     at lower time becomes the axis-name-mismatch finding (with no comms —
     the program never lowered); any other lowering error propagates.
+
+    `expect_resharding` marks a GSPMD program (automatic partitioning):
+    partitioner-inserted post-opt collectives are expected there and the
+    optimized-vs-traced all-gather surplus check is skipped — the traced
+    inventory and the peak-bytes budget remain the gates.
     """
     import jax
 
@@ -681,7 +696,8 @@ def analyze_program(fn, args, target: str, *, num_devices: int,
                     if isinstance(c, dict))
             flops = f if f > 0 else None
     findings += check_accidental_replication(
-        module, target, params_bytes=params_bytes, optimized_text=opt_text)
+        module, target, params_bytes=params_bytes, optimized_text=opt_text,
+        expect_resharding=expect_resharding)
 
     count, total_bytes, per_op, per_op_bytes = summarize_inventory(inventory)
     comms = ProgramComms(

@@ -139,6 +139,40 @@ def check_dead_cast(jaxpr, target: str) -> List[Finding]:
     return out
 
 
+def check_unconstrained_intermediate(jaxpr, target: str,
+                                     tensor_axis_size: int) -> List[Finding]:
+    """A tensor-sharded client step (GSPMD, mesh tensor axis > 1) whose
+    matmul/einsum intermediates carry NO sharding constraint. Without the
+    `constrain` hooks the partitioner is free to (and in practice does)
+    re-gather every activation replicated between layers — the program
+    still runs, still converges, and silently loses the entire per-device
+    peak-memory win the tensor axis exists for. One finding per program:
+    the fix is model-level (thread `parallel.activations.constrain` through
+    the intermediates), not per-dot."""
+    if tensor_axis_size <= 1:
+        # a 1-shard tensor axis is trivially replicated; constraints are
+        # structurally off there by design (bit-identity at shards=1)
+        return []
+    n_dots = 0
+    n_constraints = 0
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in MATMUL_PRIMS:
+            n_dots += 1
+        elif name == "sharding_constraint":
+            n_constraints += 1
+    if n_dots and not n_constraints:
+        return [Finding(
+            "unconstrained-intermediate", target,
+            f"{n_dots} matmul intermediate(s), 0 sharding constraints on a "
+            f"{tensor_axis_size}-way tensor axis — GSPMD re-gathers the "
+            f"activations replicated between layers; mark the model's "
+            f"attention/MLP/logits intermediates with "
+            f"parallel.activations.constrain (or build the step with its "
+            f"activation rule table)")]
+    return []
+
+
 def lint_jaxpr(jaxpr, target: str, policy=None,
                rules: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run the pure-jaxpr rules on one traced program. `policy=None` skips

@@ -184,6 +184,38 @@ def iter_jaxpr_targets(include_models: bool = True,
                        jnp.bfloat16)
 
 
+def tensor_step_jaxpr(model: str = "transformer_nwp",
+                      constrained: bool = True):
+    """Traced jaxpr of the activation-sharded client step (tensor.step,
+    parallel/tensor.py build_tensor_step_fn) on the 2x4 mesh, plus the
+    tensor-axis size — the unconstrained-intermediate repo-clean pin.
+    `constrained=False` builds the step WITHOUT its activation rule table
+    (the lint fixture arm: same program, constraint hooks dark)."""
+    from jax.sharding import Mesh
+
+    from fedml_tpu.core.trainer import NWPTrainer
+    from fedml_tpu.parallel.tensor import (TensorSharding,
+                                           build_tensor_step_fn)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("clients", "tensor"))
+    cfg = FedConfig(model=model, batch_size=2, epochs=1, dtype="float32",
+                    tensor_shards=4)
+    trainer = NWPTrainer(create_model(model, output_dim=10))
+    step_fn = build_tensor_step_fn(
+        trainer, cfg, TensorSharding.for_model(mesh, model),
+        activation_rules="auto" if constrained else None)
+    rng = jax.random.PRNGKey(0)
+    var_shapes = jax.eval_shape(
+        lambda: trainer.init(rng, jnp.zeros((2, 16), jnp.int32)))
+    gv = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shapes)
+    args = (gv, jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
+            jax.ShapeDtypeStruct((2, 4, 16), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.int32), rng)
+    return jax.make_jaxpr(step_fn)(*args).jaxpr, 4
+
+
 def check_chunked_donation() -> List[Finding]:
     """The chunked runner's (variables, opt_state, steps) carry must lower
     as donated buffers — otherwise every chunk boundary pays a full-carry
@@ -267,6 +299,7 @@ def check_tensor_rule_coverage(rule_tables=None,
     import re
 
     from fedml_tpu.analysis.partition import _flat_paths
+    from fedml_tpu.models.lora import init_lora_adapters
     from fedml_tpu.parallel.tensor import FAMILY_MODELS, RULE_TABLES
 
     tables = RULE_TABLES if rule_tables is None else rule_tables
@@ -275,6 +308,16 @@ def check_tensor_rule_coverage(rule_tables=None,
     for family in sorted(tables):
         rules = list(tables[family])
         used = [False] * len(rules)
+
+        def mark_used(tree):
+            for path, leaf in _flat_paths(tree):
+                if getattr(leaf, "ndim", 0) == 0:
+                    continue
+                for i, (pattern, _) in enumerate(rules):
+                    if re.search(pattern, path):
+                        used[i] = True
+                        break
+
         for name in models.get(family, ()):
             if name not in available_models():
                 continue
@@ -283,13 +326,23 @@ def check_tensor_rule_coverage(rule_tables=None,
             tree = model_variable_shapes(module, shape, in_dtype)
             out += check_partition_coverage(
                 tree, f"tensor-rules:{family}:{name}", rules=rules)
-            for path, leaf in _flat_paths(tree):
-                if getattr(leaf, "ndim", 0) == 0:
-                    continue
-                for i, (pattern, _) in enumerate(rules):
-                    if re.search(pattern, path):
-                        used[i] = True
-                        break
+            mark_used(tree)
+            # the LoRA composition these tables explicitly carry rules for
+            # (models/lora.py wraps any family model: replicated lora_A/B
+            # adapters over the tensor-sharded frozen base) — the adapter
+            # leaves must be covered too, and covering them is what keeps
+            # the lora_[AB] rule live in the dead-rule direction below
+            try:
+                adapters = jax.eval_shape(
+                    lambda p: init_lora_adapters(p, 8, jax.random.PRNGKey(0)),
+                    tree.get("params", tree))
+            except ValueError:
+                adapters = None  # no 2D kernel eligible in this family model
+            if adapters:
+                out += check_partition_coverage(
+                    adapters, f"tensor-rules:{family}:{name}+lora8",
+                    rules=rules)
+                mark_used(adapters)
         for hit, (pattern, spec) in zip(used, rules):
             if not hit:
                 out.append(Finding(
@@ -307,7 +360,7 @@ def check_tensor_rule_coverage(rule_tables=None,
 # tracing (not just listing) makes the enumeration crash the moment a
 # signature arm drifts from the real builders.
 DRIVE_CONFIGS = ("eager", "pipelined", "buffered", "tensor", "sharded",
-                 "hierarchical", "silo", "serving")
+                 "hierarchical", "silo", "serving", "finetune")
 
 
 def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
@@ -407,6 +460,32 @@ def enumerate_drive_programs(drive: str) -> dict:
         round_fn = build_round_fn(trainer, cfg, agg)
         jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
         programs["engine.round[lr,f32,fedavg]"] = 1
+    elif drive == "finetune":
+        # the flag-gated fine-tuning twins of the eager drive: a plain
+        # eager run never compiles these, so they get their own (no-CLI,
+        # no-max_compiles) config instead of inflating the eager ceiling.
+        # federated-LoRA round (a --lora_rank run reaches it): adapters
+        # under "params", frozen base riding as the lora_base collection —
+        # a distinct jit signature the budget pins as its own program
+        from fedml_tpu.models.lora import LoRATrainer
+
+        ltrainer = LoRATrainer(trainer, rank=8)
+        lgv, lx, ly, lcounts, lrng = _abstract_round_args(
+            ltrainer, shape, in_dtype)
+        round_l = build_round_fn(ltrainer, cfg, agg)
+        jax.eval_shape(round_l, lgv, jax.eval_shape(agg.init_state, lgv),
+                       lx, ly, lcounts, lrng)
+        programs["engine.round[lr,f32,fedavg,lora8]"] = 1
+        # fused-kernel twin (a --fused_kernel run reaches it): the
+        # CNN_DropOut epoch kernel replacing the vmap round wholesale
+        ftrainer, fshape, f_dtype = _tiny_trainer("cnn", "float32")
+        fcfg = FedConfig(model="cnn", batch_size=2, epochs=1,
+                         dtype="float32", fused_kernel=True, grad_clip=10.0)
+        fgv, fx, fy, fcounts, frng = _abstract_round_args(
+            ftrainer, fshape, f_dtype)
+        round_f = build_round_fn(ftrainer, fcfg, agg)
+        jax.eval_shape(round_f, fgv, agg_state, fx, fy, fcounts, frng)
+        programs["engine.round[cnn,f32,fedavg,fused]"] = 1
     elif drive == "pipelined":
         # chaos is on for the pipelined config, so every round carries a
         # participation mask — only the masked arm ever compiles
@@ -469,6 +548,17 @@ def enumerate_drive_programs(drive: str) -> dict:
             jax.eval_shape(round_c, gv, jax.eval_shape(init_st, gv),
                            x, y, counts, rng)
             programs[f"tensor.round[lr,f32,fedavg,2x4,{codec.name}]"] = 1
+        # --shard_step twin: the GSPMD activation-sharded round
+        # (build_tensor_step_round_fn) replacing the shard_map round
+        from fedml_tpu.parallel.tensor import build_tensor_step_round_fn
+
+        cfg_ss = FedConfig(model="lr", batch_size=2, epochs=1,
+                           dtype="float32", tensor_shards=4,
+                           shard_step=True)
+        round_ss = build_tensor_step_round_fn(
+            trainer, cfg_ss, agg, sharding, donate_state=False)
+        jax.eval_shape(round_ss, gv, agg_state, x, y, counts, rng)
+        programs["tensor.step[lr,f32,fedavg,2x4]"] = 1
     elif drive == "sharded":
         from jax.sharding import Mesh
 
@@ -542,6 +632,13 @@ def run_all(repo_root: str, include_models: bool = True,
     report.mark("partition-coverage[registry]")
     report.extend(check_tensor_rule_coverage())
     report.mark("partition-coverage[tensor-rules]")
+    from fedml_tpu.analysis.jaxpr_engine import (
+        check_unconstrained_intermediate)
+
+    step_jaxpr, t_sz = tensor_step_jaxpr()
+    report.extend(check_unconstrained_intermediate(
+        step_jaxpr, "tensor.step[tformer,f32,2x4]", tensor_axis_size=t_sz))
+    report.mark("tensor.step[tformer,f32,2x4]")
     if include_ast:
         report.extend(lint_tree(repo_root, ["fedml_tpu", "tools"]))
         report.mark("ast[fedml_tpu,tools]")

@@ -103,6 +103,31 @@ class FedConfig:
     # table (parallel/tensor.py). Bit-identical in f32 to the replicated
     # round (tests/test_tensor_shard.py); 0 = replicated params.
     tensor_shards: int = 0
+    # With tensor_shards > 1: shard the CLIENT STEP's compute too — the
+    # round jits under GSPMD with params tensor-sharded per the rule table
+    # and `with_sharding_constraint` hooks on the model zoo's matmul
+    # intermediates (parallel/activations.py), so attention/MLP/logits
+    # activations stay split over the tensor axis (Megatron-style,
+    # Shoeybi et al. 2019). Per-device peak bytes of the step drop <=0.5x
+    # at 4 shards (COMMS_BUDGET.json `tensor.step` entries). Trades f32
+    # bit-identity for an allclose contract (reassociated contractions);
+    # at tensor_shards <= 1 the constraints are structurally off and the
+    # program stays bit-identical. Opt-in; default keeps the shard_map
+    # storage-sharded round.
+    shard_step: bool = False
+    # >0 wraps the trainer in LoRA (models/lora.py): base params frozen
+    # under a "lora_base" collection (tensor-sharded on the 2D mesh),
+    # rank-r adapters under "params" — only adapters are federated,
+    # aggregated, codec-compressed, and checkpointed. 0 = structurally
+    # off (the trainer is never wrapped; legacy programs bit-identical).
+    lora_rank: int = 0
+    # Route the vmap engine's epoch through the fused pallas SGD kernel
+    # (ops/fused_sgd.py) — one kernel per epoch instead of per-op XLA
+    # (ROADMAP item 1a). femnist-CNN-shaped models only; CPU runs the
+    # kernel in interpret mode (correctness-honest, no speed claim —
+    # tools/bench_fused.py). Mutually exclusive with tensor_shards /
+    # update_codec / buffer_size.
+    fused_kernel: bool = False
     # Opt-in O(cohort) stateless cohort sampler (Feistel permutation over
     # client ids). Default off: the default path keeps bit-compat with the
     # seeded rng.choice trajectory of fedavg.client_sampling.

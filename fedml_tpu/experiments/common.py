@@ -82,6 +82,21 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--tensor_shards", type=int, default=0,
                         help="tensor-axis size of the 2D (clients, tensor) "
                              "mesh (0 = replicated params)")
+    parser.add_argument("--shard_step", type=int, default=0,
+                        help="1 = activation-shard the client step itself "
+                             "(GSPMD + with_sharding_constraint on model "
+                             "intermediates; allclose contract, needs "
+                             "--tensor_shards > 1)")
+    # federated LoRA (models/lora.py): frozen base + rank-r adapters;
+    # only adapters cross the wire / hit the aggregator / get checkpointed
+    parser.add_argument("--lora_rank", type=int, default=0,
+                        help="LoRA adapter rank; 0 = full fine-tuning "
+                             "(trainer never wrapped, legacy programs)")
+    # fused pallas SGD epoch kernel (ops/fused_sgd.py, ROADMAP item 1a)
+    parser.add_argument("--fused_kernel", type=int, default=0,
+                        help="1 = run the local epoch as ONE fused pallas "
+                             "kernel (femnist-CNN shapes; interpret mode "
+                             "on CPU)")
     parser.add_argument("--fast_sampling", type=int, default=0,
                         help="1 = O(cohort) Feistel-permutation cohort "
                              "sampler (different seeded trajectory than the "
@@ -212,6 +227,8 @@ def config_from_args(args) -> FedConfig:
     else:
         d.pop("mesh_shape", None)
     d["fast_sampling"] = bool(d.get("fast_sampling", 0))
+    d["shard_step"] = bool(d.get("shard_step", 0))
+    d["fused_kernel"] = bool(d.get("fused_kernel", 0))
     return FedConfig.from_dict(d)
 
 
@@ -266,4 +283,9 @@ def setup_run(args) -> tuple[FedConfig, FederatedDataset, object]:
         trainer = TagPredictionTrainer(module)
     else:
         trainer = ClassificationTrainer(module)
+    # federated LoRA: wrap AFTER task-trainer construction so the adapter
+    # seam is task-agnostic; --lora_rank 0 returns the trainer unchanged
+    from fedml_tpu.models.lora import maybe_wrap_lora
+
+    trainer = maybe_wrap_lora(trainer, cfg)
     return cfg, ds, trainer

@@ -48,6 +48,11 @@ class CNN_DropOut(nn.Module):
 
     output_dim: int = 10
     dtype: Any = jnp.float32
+    # reference rates; module attrs so the fused-kernel A/B can run a
+    # dropout-free twin through the SAME class (the engine's --fused_kernel
+    # gate keys on this module and mirrors these rates into FusedEpochSpec)
+    drop1: float = 0.25
+    drop2: float = 0.5
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -55,10 +60,10 @@ class CNN_DropOut(nn.Module):
         x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype, name="conv2d_1")(x))
         x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype, name="conv2d_2")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.Dropout(self.drop1, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(128, dtype=self.dtype, name="linear_1")(x))
-        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dropout(self.drop2, deterministic=not train)(x)
         return nn.Dense(self.output_dim, dtype=self.dtype, name="linear_2")(x).astype(jnp.float32)
 
 

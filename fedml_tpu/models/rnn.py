@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import flax.linen as nn
 
+from fedml_tpu.parallel.activations import constrain
+
 
 class RNN_OriginalFedAvg(nn.Module):
     vocab_size: int = 90
@@ -32,10 +34,15 @@ class RNN_OriginalFedAvg(nn.Module):
         # x: [b, seq] int tokens
         h = nn.Embed(self.vocab_size, self.embedding_dim, dtype=self.dtype,
                      name="embeddings")(x)
+        # activation-sharding hooks (identity outside a scope) keep the
+        # channel dims on the mesh's tensor axis; placed BEFORE the final-
+        # position slice so the spec rank holds in both emission modes
+        h = constrain(h, "embed")
         h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
                    name="lstm1")(h)
         h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
                    name="lstm2")(h)
+        h = constrain(h, "rnn_hidden")
         if not self.per_position:
             h = h[:, -1]
         return nn.Dense(self.vocab_size, dtype=self.dtype, name="fc")(h)
@@ -54,8 +61,12 @@ class RNN_StackOverFlow(nn.Module):
         extended = self.vocab_size + 3 + self.num_oov_buckets
         h = nn.Embed(extended, self.embedding_size, dtype=self.dtype,
                      name="word_embeddings")(x)
+        h = constrain(h, "embed")
         for i in range(self.num_layers):
             h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size, dtype=self.dtype),
                        name=f"lstm{i + 1}")(h)
+        h = constrain(h, "rnn_hidden")
         h = nn.Dense(self.embedding_size, dtype=self.dtype, name="fc1")(h)
-        return nn.Dense(extended, dtype=self.dtype, name="fc2")(h)  # [b, seq, extended_vocab]
+        h = constrain(h, "fc_hidden")
+        logits = nn.Dense(extended, dtype=self.dtype, name="fc2")(h)
+        return constrain(logits, "logits")  # [b, seq, extended_vocab]

@@ -17,6 +17,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from fedml_tpu.ops.attention import flash_attention
+from fedml_tpu.parallel.activations import constrain
 
 
 class _Block(nn.Module):
@@ -33,17 +34,22 @@ class _Block(nn.Module):
         hd = dm // self.heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         qkv = nn.Dense(3 * dm, use_bias=False, dtype=self.dtype, name="qkv")(h)
+        # activation-sharding hooks (identity outside a scope): the qkv /
+        # attention-context / MLP-hidden intermediates are where Megatron
+        # column/row splits keep the channel dim on the mesh's tensor axis
+        qkv = constrain(qkv, "attn_qkv")
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, hd),
                             3, axis=2)  # each [B, T, H, hd]
         # flash kernel wants block-divisible T: pick the largest power-of-two
         # divisor of T up to 128 (any T works; odd T degenerates to blk=1)
         blk = next(bb for bb in (128, 64, 32, 16, 8, 4, 2, 1) if t % bb == 0)
         attn = flash_attention(q, k, v, True, blk, blk)
-        attn = attn.reshape(b, t, dm)
+        attn = constrain(attn.reshape(b, t, dm), "attn_ctx")
         x = x + nn.Dense(dm, use_bias=False, dtype=self.dtype, name="proj")(attn)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         h = nn.gelu(nn.Dense(self.mlp_ratio * dm, dtype=self.dtype,
                              name="mlp_up")(h))
+        h = constrain(h, "mlp_hidden")
         return x + nn.Dense(dm, dtype=self.dtype, name="mlp_down")(h)
 
 
@@ -72,5 +78,8 @@ class TransformerLM(nn.Module):
             x = _Block(self.d_model, self.heads, dtype=self.dtype,
                        name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                        name="lm_head")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        # the (b, t, vocab) logits are the step's biggest activation; vocab
+        # stays sharded into the loss (GSPMD reduces the CE over shards)
+        return constrain(logits, "logits")

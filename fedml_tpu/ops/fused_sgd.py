@@ -468,7 +468,8 @@ def fused_epoch(spec: FusedEpochSpec, params, x, y, seeds, interpret=False):
         # the step working set (patches, activations, f32 grads, the resident
         # weight blocks) needs ~74 MB of VMEM — far above the conservative
         # 16 MB default scoped limit, well inside v5e's 128 MB
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             vmem_limit_bytes=100 * 1024 * 1024),
         cost_estimate=pl.CostEstimate(
             flops=flops_step * spec.steps * clients,
@@ -494,17 +495,29 @@ def fused_epoch(spec: FusedEpochSpec, params, x, y, seeds, interpret=False):
 
 
 def build_fused_round_fn(spec: FusedEpochSpec, aggregator, shuffle=True,
-                         interpret=False):
+                         interpret=False, collect_stats=False):
     """Engine-signature round over the fused kernel:
     round_fn(gv, agg_state, x, y, counts, rng) -> (gv, agg_state, metrics).
 
     Client shuffling happens outside the kernel (one gather per round — the
     out-of-kernel analog of engine.py's per-epoch argsort permutation);
     dropout streams are seeded per (round, client) from the round rng.
-    """
-    from fedml_tpu.algorithms.engine import LocalResult
 
-    def round_fn(gv, agg_state, x, y, counts, rng):
+    `collect_stats=True` appends the engine's `cohort_stats` health rows as
+    a fourth output (same contract as `engine.build_round_fn`), so the
+    FedAvg drive's ledger plumbing works unchanged on the fused path. The
+    kernel has no participation/quarantine stage — a non-None
+    `participation` raises at trace time rather than silently training
+    dropped clients.
+    """
+    from fedml_tpu.algorithms.engine import LocalResult, cohort_stats
+
+    def round_fn(gv, agg_state, x, y, counts, rng, participation=None):
+        if participation is not None:
+            raise ValueError(
+                "the fused kernel round has no participation/quarantine "
+                "stage — run without chaos faults or cohort padding, or "
+                "drop --fused_kernel")
         clients = x.shape[0]
         prng, srng = jax.random.split(rng)
         if shuffle:
@@ -523,9 +536,13 @@ def build_fused_round_fn(spec: FusedEpochSpec, aggregator, shuffle=True,
             num_steps=jnp.full((clients,), spec.steps, jnp.int32),
             metrics=metrics,
         )
+        stats = cohort_stats(gv, result) if collect_stats else None
         gv, agg_state = aggregator(gv, result, counts.astype(jnp.float32),
                                    rng, agg_state)
-        return gv, agg_state, {k: v.sum() for k, v in metrics.items()}
+        summed = {k: v.sum() for k, v in metrics.items()}
+        if collect_stats:
+            return gv, agg_state, summed, stats
+        return gv, agg_state, summed
 
     return jax.jit(round_fn)
 

@@ -1,0 +1,103 @@
+"""Activation-sharding constraint hooks — Megatron compute splitting as a
+scoped side-channel into the model zoo.
+
+`parallel/tensor.py`'s rule tables shard *storage*: the shard_map round
+gathers full params per device before the forward, so the client step's
+activations (and the gathered params) still materialize replicated. The
+activation-sharded client step (`build_tensor_step_fn`) instead jits the
+step under GSPMD with `NamedSharding` in_shardings from the same rule
+tables — and the models mark their matmul intermediates with `constrain`
+so the partitioner keeps attention/MLP/logits activations split over the
+mesh's 'tensor' axis instead of re-gathering them between layers (the
+`with_sharding_constraint` pattern, Shoeybi et al. 2019).
+
+The hook is a ContextVar scope: OUTSIDE `activation_sharding(...)` every
+`constrain` call is the identity, so the legacy paths (vmap engine,
+shard_map tensor.round, buffered client_step) trace byte-identical
+programs — activation sharding is structurally off unless a builder opts
+in. Inside the scope, a constraint is applied only when the active rule
+table names the site AND the mesh's tensor axis is >1 (a 1-shard mesh is
+trivially replicated — bit-identity at tensor_shards=1 is preserved).
+
+Specs are written at the rank the model code sees — NOT the client-batched
+rank. The client step vmaps the model over the cohort, and vmap's batching
+rule prepends the batch dim to every constraint automatically; a spec
+written at the batched rank would raise "only valid for values of rank at
+least N" at trace time (pinned in tests/test_lora.py).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Dict, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+TENSOR_AXIS = "tensor"
+
+# site name -> PartitionSpec at the model-code rank (batch dims the model
+# itself sees are part of the rank; the client vmap dim is NOT).
+# Transformer activations are (b, t, channels): shard the channel dim.
+TRANSFORMER_ACTIVATION_RULES: Dict[str, PS] = {
+    "attn_qkv": PS(None, None, TENSOR_AXIS),    # (b, t, 3*d_model)
+    "attn_ctx": PS(None, None, TENSOR_AXIS),    # (b, t, d_model) pre-proj
+    "mlp_hidden": PS(None, None, TENSOR_AXIS),  # (b, t, mlp_ratio*d_model)
+    "logits": PS(None, None, TENSOR_AXIS),      # (b, t, vocab)
+}
+
+# RNN activations are (b, t, channels) too (post-embed / post-LSTM / fc).
+RNN_ACTIVATION_RULES: Dict[str, PS] = {
+    "embed": PS(None, None, TENSOR_AXIS),       # (b, t, embed_dim)
+    "rnn_hidden": PS(None, None, TENSOR_AXIS),  # (b, t, hidden)
+    "fc_hidden": PS(None, None, TENSOR_AXIS),   # (b, t, fc width)
+    "logits": PS(None, None, TENSOR_AXIS),      # (b, t, vocab)
+}
+
+ACTIVATION_RULE_TABLES: Dict[str, Dict[str, PS]] = {
+    "transformer": TRANSFORMER_ACTIVATION_RULES,
+    "rnn": RNN_ACTIVATION_RULES,
+}
+
+
+def activation_rules_for_model(model_name: str) -> Optional[Dict[str, PS]]:
+    """Prefix dispatch mirroring tensor.rules_for_model: transformer* and
+    rnn* get their family table; every other model has no constrained
+    intermediates (its step shards params only)."""
+    for family, rules in ACTIVATION_RULE_TABLES.items():
+        if model_name.startswith(family):
+            return rules
+    return None
+
+
+_SCOPE: ContextVar[Optional[Tuple]] = ContextVar(
+    "activation_sharding_scope", default=None)
+
+
+@contextmanager
+def activation_sharding(mesh, rules: Optional[Dict[str, PS]]):
+    """Arm `constrain` for the duration of a trace. `rules=None` (model
+    families without a table) leaves every hook as the identity."""
+    if rules is None or mesh.shape.get(TENSOR_AXIS, 1) <= 1:
+        yield
+        return
+    token = _SCOPE.set((mesh, rules))
+    try:
+        yield
+    finally:
+        _SCOPE.reset(token)
+
+
+def constrain(x, site: str):
+    """Pin intermediate `x`'s sharding when a scope is active; identity
+    otherwise. Called from inside the model zoo, so it must stay free on
+    every legacy path (no scope -> no-op, not even a reshape)."""
+    scope = _SCOPE.get()
+    if scope is None:
+        return x
+    mesh, rules = scope
+    spec = rules.get(site)
+    if spec is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

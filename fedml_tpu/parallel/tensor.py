@@ -74,6 +74,12 @@ TENSOR_AXIS = "tensor"
 # row-parallel (shard in-features — the same heads / ffn dim), embeddings
 # and lm_head shard d_model. Norms and biases replicate.
 TRANSFORMER_PARTITION_RULES: List[Tuple[str, PS]] = [
+    # LoRA adapters (models/lora.py) replicate: rank-r factors are tiny and
+    # every device needs both to fold base + A @ B. The frozen base keeps
+    # matching the kernel rules below through its lora_base/... paths, so a
+    # LoRA-wrapped model tensor-shards the big frozen matrices while the
+    # federated (trainable) tree stays replicated. First match wins.
+    (r"lora_[AB]$", PS()),
     (r"(tok_emb|pos_emb)/embedding$", PS(None, TENSOR_AXIS)),
     (r"qkv/kernel$", PS(None, TENSOR_AXIS)),
     (r"proj/kernel$", PS(TENSOR_AXIS, None)),
@@ -88,6 +94,7 @@ TRANSFORMER_PARTITION_RULES: List[Tuple[str, PS]] = [
 # out-features. 670-unit stackoverflow kernels are not divisible by small
 # tensor axes — resolve_param_specs demotes those leaves to replicated.
 RNN_PARTITION_RULES: List[Tuple[str, PS]] = [
+    (r"lora_[AB]$", PS()),  # adapters replicate (see transformer table)
     (r"embeddings/embedding$", PS(None, TENSOR_AXIS)),
     (r"OptimizedLSTMCell_\d+/[ih][ifgo]/kernel$", PS(None, TENSOR_AXIS)),
     (r"fc\d?/kernel$", PS(None, TENSOR_AXIS)),
@@ -100,6 +107,7 @@ RNN_PARTITION_RULES: List[Tuple[str, PS]] = [
 # kernel rule on their tiny kh dim and get demoted to replicated — safe,
 # just not sharded.
 DEFAULT_TENSOR_RULES: List[Tuple[str, PS]] = [
+    (r"lora_[AB]$", PS()),  # adapters replicate (see transformer table)
     (r"embedding$", PS(None, TENSOR_AXIS)),
     (r"kernel$", PS(TENSOR_AXIS, None)),
     (r"(bias|scale)$", PS()),
@@ -311,14 +319,19 @@ def init_codec_agg_state(sharding: "TensorSharding", global_variables,
     aggregator state tensor-sharded as usual, plus the per-device
     error-feedback residual (zeros, one slot per clients-axis device,
     trailing dims sharded like gv). Donated with the rest of the state."""
+    from fedml_tpu.models.lora import strip_lora_base
+
+    # the residual mirrors the WIRE tree — adapters-only under LoRA (the
+    # frozen base never crosses the uplink, so it carries no error feedback)
+    fed_gv = strip_lora_base(global_variables)
     n_cl = sharding.mesh.shape[CLIENT_AXIS]
     resid = jax.tree.map(
         lambda l: jnp.zeros(
             (n_cl,) + (l.shape if jnp.issubdtype(l.dtype, jnp.inexact)
                        else ()), l.dtype),
-        global_variables)
-    specs_gv = sharding.specs(global_variables)
-    rspecs = codec_residual_specs(specs_gv, global_variables)
+        fed_gv)
+    specs_gv = sharding.specs(fed_gv)
+    rspecs = codec_residual_specs(specs_gv, fed_gv)
     shardings = jax.tree.map(
         lambda s: NamedSharding(sharding.mesh, s), rspecs,
         is_leaf=lambda s: isinstance(s, PS))
@@ -408,6 +421,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
     """
     from fedml_tpu.algorithms.aggregators import quarantine_stage
     from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
 
     mesh = sharding.mesh
     n_cl = mesh.shape[CLIENT_AXIS]
@@ -430,6 +444,13 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
         is_fedopt = isinstance(aggregator, FedOptAggregator)
 
     def specialize(specs_gv, specs_st, masked: bool):
+        # federated LoRA: client results are adapters-only (the base leaves
+        # local_update inside the vmap), so every aggregation-side tree.map
+        # must run over the base-stripped "federated view" of gv/specs —
+        # identical to the full trees when the trainer isn't wrapped
+        specs_fed = strip_lora_base(specs_gv) if isinstance(specs_gv, dict) \
+            else specs_gv
+
         def shard_body(gv_shard, st_shard, x, y, counts, rng,
                        participation=None):
             c_local = x.shape[0]
@@ -452,10 +473,14 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                 result, weights, alive, quarantined = quarantine_stage(
                     result, weights, participation)
             result_shard = result._replace(variables=_slice_tree(
-                result.variables, specs_gv, t_sz, lead=1))
+                result.variables, specs_fed, t_sz, lead=1))
             new_gshard, new_st = _aggregate_sharded(
-                aggregator, gv_shard, gv_full, result, result_shard,
-                weights, rng, st_shard, specs_gv, t_sz)
+                aggregator, strip_lora_base(gv_shard),
+                strip_lora_base(gv_full), result, result_shard,
+                weights, rng, st_shard, specs_fed, t_sz)
+            # the server's frozen base shards re-attach untouched (no-op
+            # when the trainer isn't LoRA-wrapped)
+            new_gshard = attach_lora_base(new_gshard, gv_shard)
             metrics = {k: jax.lax.psum(v.sum(), CLIENT_AXIS)
                        for k, v in result.metrics.items()}
             if participation is None:
@@ -497,8 +522,9 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             if participation is not None:
                 result, weights, alive, quarantined = quarantine_stage(
                     result, weights, participation)
-            vars_shard = _slice_tree(result.variables, specs_gv, t_sz,
+            vars_shard = _slice_tree(result.variables, specs_fed, t_sz,
                                      lead=1)
+            fed_gshard = strip_lora_base(gv_shard)
 
             # local numerator partials: sum_i w_i * (vars_i - gv) for
             # inexact leaves (deltas are what the codec encodes — small,
@@ -510,7 +536,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                                    axis=0)
                 return jnp.sum(l * wb.astype(l.dtype), axis=0)
 
-            wsum = jax.tree.map(local_partial, vars_shard, gv_shard)
+            wsum = jax.tree.map(local_partial, vars_shard, fed_gshard)
             r0 = jax.tree.map(lambda r: r[0], resid)
             num, r_new = transport_wsum(codec, wsum, r0, CLIENT_AXIS, n_cl)
             den = jax.lax.psum(weights.sum(), CLIENT_AXIS)
@@ -520,12 +546,13 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                     g.dtype)
                 if jnp.issubdtype(g.dtype, jnp.inexact)
                 else (s * inv).astype(g.dtype),
-                gv_shard, num)
+                fed_gshard, num)
             if is_fedopt:
                 new_gshard, new_inner = aggregator._server_step(
-                    gv_shard, avg, inner_st)
+                    fed_gshard, avg, inner_st)
             else:
                 new_gshard, new_inner = avg, inner_st
+            new_gshard = attach_lora_base(new_gshard, gv_shard)
             new_st = {"agg": new_inner,
                       "codec": jax.tree.map(lambda r: r[None], r_new)}
             metrics = {k: jax.lax.psum(v.sum(), CLIENT_AXIS)
@@ -579,10 +606,14 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                 # wrapped {"agg", "codec"} state (init_codec_agg_state):
                 # inner state sharded as usual, residual rows on the
                 # shifted (CLIENT_AXIS, ..., TENSOR_AXIS) layout
+                from fedml_tpu.models.lora import strip_lora_base as _strip
+                fed_gv = _strip(global_variables)
                 specs_st = {
                     "agg": sharding.specs(agg_state["agg"]),
-                    "codec": codec_residual_specs(specs_gv,
-                                                  global_variables),
+                    "codec": codec_residual_specs(_strip(specs_gv)
+                                                  if isinstance(specs_gv,
+                                                                dict)
+                                                  else specs_gv, fed_gv),
                 }
             else:
                 specs_st = sharding.specs(agg_state)
@@ -618,4 +649,198 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                    donate=donate_state,
                    mesh=f"{n_cl}x{t_sz}",
                    codec=(codec.name if codec is not None else "none"))
+    return round_fn
+
+
+# ----------------------------------------- activation-sharded step (GSPMD)
+#
+# The shard_map round above gathers FULL params to every device before the
+# client vmap step — per-device peak bytes during the step scale with the
+# whole model. `--shard_step` swaps that for GSPMD automatic partitioning:
+# the round jits with params tensor-sharded per the rule table as
+# `in_shardings` and the model zoo's `constrain()` hooks
+# (parallel/activations.py) pin attention/MLP/logits intermediates to the
+# tensor axis, so the step's matmuls split Megatron-style and the big
+# activations never materialize whole on one device. Measured on the forced
+# 8-device CPU mesh: 0.24x per-device peak temp bytes for the transformer
+# step at 4 shards (COMMS_BUDGET.json `tensor.step` twins pin the <=0.5x
+# ratio in CI). The trade, documented in ROADMAP/PERF: GSPMD reassociates
+# float contractions, so `shard_step` carries an allclose contract
+# (tests/test_lora.py pins the tolerance) instead of the shard_map path's
+# f32 bit-identity; at tensor_shards <= 1 the constraint scope is
+# structurally off and the program is the plain jitted round.
+
+def build_tensor_step_fn(trainer, cfg: FedConfig, sharding: TensorSharding,
+                         activation_rules="auto"):
+    """The client step ALONE — vmap(local_update) jitted under GSPMD with
+    rule-table `in_shardings` and the activation-constraint scope. This is
+    the `tensor.step` program analysis/comms.py lowers for the per-device
+    peak-bytes budgets; the full drive uses build_tensor_step_round_fn.
+
+    `activation_rules`: "auto" looks the model family's table up
+    (parallel/activations.py); None disables the constraint scope — the
+    replicated budget twin the <=0.5x peak ratio is measured against."""
+    from fedml_tpu.algorithms.engine import build_local_update
+    from fedml_tpu.parallel.activations import (activation_rules_for_model,
+                                                activation_sharding)
+
+    mesh = sharding.mesh
+    act_rules = (activation_rules_for_model(cfg.model)
+                 if activation_rules == "auto" else activation_rules)
+    local_update = build_local_update(trainer, cfg)
+
+    def step(global_variables, x, y, counts, rng):
+        crngs = jax.random.split(rng, x.shape[0])
+        return jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+            global_variables, x, y, counts, crngs)
+
+    data_sh = NamedSharding(mesh, PS(CLIENT_AXIS))
+    cache: dict = {}
+
+    def _specialized(gv):
+        key = (jax.tree.structure(gv),
+               tuple((l.shape, str(l.dtype)) for l in jax.tree.leaves(gv)))
+        jitted = cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(step, in_shardings=(
+                sharding.shardings(gv), data_sh, data_sh, data_sh, None))
+            cache[key] = jitted
+        return jitted
+
+    def step_fn(global_variables, x, y, counts, rng):
+        # the constraint hooks read the scope at TRACE time; entering it
+        # around every call keeps cached traces consistent (the scope is a
+        # constant of this builder)
+        with activation_sharding(mesh, act_rules):
+            return _specialized(global_variables)(
+                global_variables, x, y, counts, rng)
+
+    def lower(*args):
+        with activation_sharding(mesh, act_rules):
+            return _specialized(args[0]).lower(*args)
+
+    step_fn.lower = lower
+    step_fn.sharding = sharding
+    return step_fn
+
+
+def build_tensor_step_round_fn(trainer, cfg: FedConfig, aggregator,
+                               sharding: TensorSharding,
+                               donate_state: bool = True,
+                               donate_data: bool = False,
+                               collect_stats: bool = False,
+                               codec=None) -> Callable:
+    """The `--shard_step` round: engine.round_fn semantics (same rng table,
+    same quarantine staging, same all-dead no-op guard, same LoRA
+    strip/attach) jitted under GSPMD on sharding.mesh — params, opt state
+    AND the step's intermediates tensor-sharded; aggregation math is plain
+    jnp that GSPMD partitions. State lives sharded between rounds exactly
+    like the shard_map tensor round (`sharding.place` once, outputs come
+    back identically sharded), so FedAvgAPI's tensor plumbing works
+    unchanged."""
+    if codec is not None:
+        raise ValueError(
+            "--shard_step runs under GSPMD automatic partitioning — the "
+            "codec transports are manual shard_map collectives and do not "
+            "compose with it. Drop --shard_step (the storage-sharded "
+            "tensor round supports codecs) or --update_codec.")
+    from fedml_tpu.algorithms.aggregators import quarantine_stage
+    from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
+    from fedml_tpu.parallel.activations import (activation_rules_for_model,
+                                                activation_sharding)
+
+    mesh = sharding.mesh
+    n_cl = mesh.shape[CLIENT_AXIS]
+    t_sz = mesh.shape[TENSOR_AXIS]
+    act_rules = activation_rules_for_model(cfg.model)
+    local_update = build_local_update(trainer, cfg)
+
+    def round_body(global_variables, agg_state, x, y, counts, rng,
+                   participation=None):
+        crngs = jax.random.split(rng, x.shape[0])
+        result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+            global_variables, x, y, counts, crngs)
+        stats = cohort_stats(global_variables, result) if collect_stats \
+            else None
+        weights = counts.astype(jnp.float32)
+        if participation is None:
+            new_global, new_state = aggregator(
+                global_variables, result, weights, rng, agg_state)
+            new_global = attach_lora_base(new_global, global_variables)
+            metrics = {k: v.sum() for k, v in result.metrics.items()}
+            if collect_stats:
+                return new_global, new_state, metrics, stats
+            return new_global, new_state, metrics
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator(
+            global_variables, result, weights, rng, agg_state)
+        any_alive = jnp.any(alive)
+        new_global = tree_where(any_alive, new_global,
+                                strip_lora_base(global_variables))
+        new_state = tree_where(any_alive, new_state, agg_state)
+        new_global = attach_lora_base(new_global, global_variables)
+        metrics = {k: v.sum() for k, v in result.metrics.items()}
+        metrics["participated_count"] = alive.sum().astype(jnp.float32)
+        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        if collect_stats:
+            return new_global, new_state, metrics, stats
+        return new_global, new_state, metrics
+
+    data_sh = NamedSharding(mesh, PS(CLIENT_AXIS))
+    repl_sh = NamedSharding(mesh, PS())
+    cache: dict = {}
+
+    def _specialized(global_variables, agg_state, masked: bool):
+        key = (jax.tree.structure(global_variables),
+               tuple(l.shape for l in jax.tree.leaves(global_variables)),
+               jax.tree.structure(agg_state),
+               tuple(l.shape for l in jax.tree.leaves(agg_state)),
+               masked)
+        jitted = cache.get(key)
+        if jitted is None:
+            gv_sh = sharding.shardings(global_variables)
+            st_sh = sharding.shardings(agg_state)
+            in_sh = (gv_sh, st_sh, data_sh, data_sh, data_sh, None)
+            if masked:
+                in_sh = in_sh + (data_sh,)
+            out_sh = (gv_sh, st_sh, repl_sh)
+            if collect_stats:
+                out_sh = out_sh + (data_sh,)
+            donate: Tuple[int, ...] = ()
+            if donate_state:
+                donate += (0, 1)
+            if donate_data:
+                donate += (2, 3, 4)
+            jitted = jax.jit(round_body, in_shardings=in_sh,
+                             out_shardings=out_sh, donate_argnums=donate)
+            cache[key] = jitted
+        return jitted
+
+    def round_fn(global_variables, agg_state, x, y, counts, rng,
+                 participation=None):
+        jitted = _specialized(global_variables, agg_state,
+                              participation is not None)
+        round_fn.jitted = jitted  # graft-lint donation introspection
+        args = (global_variables, agg_state, x, y, counts, rng)
+        if participation is not None:
+            args += (participation,)
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*onat")
+            with activation_sharding(mesh, act_rules):
+                return jitted(*args)
+
+    def lower(*args):
+        with activation_sharding(mesh, act_rules):
+            return _specialized(args[0], args[1],
+                                len(args) > 6).lower(*args)
+
+    round_fn.lower = lower
+    round_fn.sharding = sharding
+    round_fn.donate_state = donate_state
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="tensor.step",
+                   donate=donate_state, mesh=f"{n_cl}x{t_sz}")
     return round_fn

@@ -85,14 +85,21 @@ class JobDescriptor:
         return int(self.config.comm_round)
 
     def build_trainer(self):
+        from fedml_tpu.models.lora import maybe_wrap_lora
+
         if self.trainer_factory is not None:
-            return self.trainer_factory()
+            # factory-built trainers get the same LoRA seam the stock path
+            # has — a tenant descriptor with lora_rank > 0 federates
+            # adapters no matter how its trainer was constructed
+            return maybe_wrap_lora(self.trainer_factory(), self.config)
         from fedml_tpu.core.trainer import ClassificationTrainer
         from fedml_tpu.models.registry import create_model
 
-        return ClassificationTrainer(
-            create_model(self.config.model,
-                         output_dim=self.dataset.class_num))
+        return maybe_wrap_lora(
+            ClassificationTrainer(
+                create_model(self.config.model,
+                             output_dim=self.dataset.class_num)),
+            self.config)
 
     def build_api(self) -> FedAvgAPI:
         """A fresh FedAvgAPI for this descriptor — the SAME construction a

@@ -173,10 +173,11 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 # mesh, BENCH_BUFF_* record committed-updates/s under a synthetic straggler
 # barrier, BENCH_TENANTS_* record multi-tenant jobs/s and job latency under
 # the serving scheduler, BENCH_CODEC_* record wire-bytes-per-round and a
-# codec-on/off committed-updates/s A/B. All would poison the rounds/s
-# comparison.
+# codec-on/off committed-updates/s A/B, BENCH_LORA_* record the
+# adapter-only wire shrink and a lora-rank rounds/s A/B. All would poison
+# the rounds/s comparison.
 _GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_", "BENCH_BUFF_",
-                       "BENCH_TENANTS_", "BENCH_CODEC_",
+                       "BENCH_TENANTS_", "BENCH_CODEC_", "BENCH_LORA_",
                        # budget pin files are not benches at all; the glob
                        # below can't match them today, but skip by NAME so a
                        # future BENCH_-style rename can't poison the gate

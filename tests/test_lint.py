@@ -895,6 +895,71 @@ def test_report_json_roundtrip(tmp_path):
     assert d["findings"][0]["rule"] == "dead-cast"
 
 
+# ------------------------------- unconstrained-intermediate (tensor.step)
+
+def _matmul_chain_jaxpr(constrained: bool):
+    """Two chained matmuls, optionally pinning the intermediate — the
+    minimal shape of an activation-sharded step body."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("clients", "tensor"))
+
+    def f(a, w1, w2):
+        h = a @ w1
+        if constrained:
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, PS(None, "tensor")))
+        return h @ w2
+
+    return jax.make_jaxpr(f)(jnp.zeros((2, 8)), jnp.zeros((8, 8)),
+                             jnp.zeros((8, 4))).jaxpr
+
+
+def test_unconstrained_intermediate_fires_on_bare_matmuls():
+    from fedml_tpu.analysis import check_unconstrained_intermediate
+
+    findings = check_unconstrained_intermediate(
+        _matmul_chain_jaxpr(constrained=False), "fixture",
+        tensor_axis_size=4)
+    assert findings and findings[0].rule == "unconstrained-intermediate"
+    assert "0 sharding constraints" in findings[0].message
+
+
+def test_unconstrained_intermediate_clean_with_constraint():
+    from fedml_tpu.analysis import check_unconstrained_intermediate
+
+    assert not check_unconstrained_intermediate(
+        _matmul_chain_jaxpr(constrained=True), "fixture",
+        tensor_axis_size=4)
+
+
+def test_unconstrained_intermediate_structurally_off_at_one_shard():
+    # a 1-shard tensor axis is trivially replicated — no constraint needed,
+    # no finding (the shards=1 bit-identity contract)
+    from fedml_tpu.analysis import check_unconstrained_intermediate
+
+    assert not check_unconstrained_intermediate(
+        _matmul_chain_jaxpr(constrained=False), "fixture",
+        tensor_axis_size=1)
+
+
+def test_unconstrained_intermediate_repo_step_is_clean():
+    # the real tensor.step program (transformer, activation rule table on)
+    # carries its constraints; the fixture arm with the table off fires —
+    # pinning that the finding watches the REAL seam, not a toy
+    from fedml_tpu.analysis import check_unconstrained_intermediate
+    from fedml_tpu.analysis.targets import tensor_step_jaxpr
+
+    jaxpr, t_sz = tensor_step_jaxpr()
+    assert not check_unconstrained_intermediate(
+        jaxpr, "tensor.step", tensor_axis_size=t_sz)
+    dark, t_sz = tensor_step_jaxpr(constrained=False)
+    assert check_unconstrained_intermediate(
+        dark, "tensor.step", tensor_axis_size=t_sz)
+
+
 # ------------------------------------- tensor-rule coverage (runtime tables)
 
 def test_tensor_rule_coverage_repo_tables_clean():

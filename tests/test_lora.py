@@ -1,0 +1,378 @@
+"""Federated LoRA + the activation-sharded client step (ISSUE 14).
+
+The contracts under test, each at the strength the design promises:
+
+  - frozen base: the engine differentiates ``variables["params"]`` only, so
+    the ``lora_base`` collection is BITWISE invariant across a whole drive —
+    a structural property, not a masking trick (models/lora.py);
+  - structurally off: ``lora_rank=0`` returns the very trainer object, and
+    a 1-shard tensor axis disables the activation-constraint scope, so both
+    knobs trace the exact legacy programs (bit-identity);
+  - checkpoints are adapters-only; resume and guard rollback re-attach the
+    deterministic base and land bitwise where the design says bitwise;
+  - the GSPMD ``shard_step`` round carries an ALLCLOSE contract versus the
+    vmap engine (the partitioner reassociates float contractions — the
+    documented trade for the per-device memory win), pinned here at 1e-6;
+  - the win itself: XLA ``memory_analysis`` per-device peak of the
+    activation-sharded transformer step is >=2x smaller than its
+    replicated twin at 4 shards (COMMS_BUDGET.json pins <=0.5x in CI);
+  - the wire: committed COMMS budgets show >=50x adapter-only param-byte
+    shrink at rank 8, and lora+topk strictly below either alone.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as PS
+
+from fedml_tpu.algorithms.aggregators import make_aggregator
+from fedml_tpu.algorithms.engine import build_local_update, build_round_fn
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer, NWPTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.lora import (
+    LORA_COLLECTION,
+    LoRATrainer,
+    maybe_wrap_lora,
+    strip_lora_base,
+)
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.parallel import TensorSharding, make_tensor_mesh
+from fedml_tpu.parallel.tensor import (
+    REPLICATED_RULES,
+    build_tensor_step_fn,
+    build_tensor_step_round_fn,
+)
+from fedml_tpu.robustness.guard import GuardVerdict
+from fedml_tpu.utils.checkpoint import all_checkpoint_steps
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bitwise_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+def _max_abs_delta(a, b):
+    d = jax.tree.map(lambda u, v: float(jnp.max(jnp.abs(u - v))), a, b)
+    return max(jax.tree.leaves(d), default=0.0)
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _cfg(**kw):
+    kw.setdefault("dataset", "mnist")
+    kw.setdefault("model", "lr")
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("lr", 0.05)
+    kw.setdefault("client_num_in_total", 8)
+    kw.setdefault("client_num_per_round", 8)
+    kw.setdefault("seed", 0)
+    return FedConfig(**kw)
+
+
+def _lora_api(ds, cfg):
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=ds.class_num)),
+        cfg)
+    return FedAvgAPI(ds, cfg, trainer)
+
+
+# -------------------------------------------------------- adapter structure
+
+def test_lora_wrap_starts_bit_identical_to_unwrapped():
+    """B initializes to zeros, so base + (A @ B) * scale == base and the
+    wrapped model's first forward matches the unwrapped one bitwise."""
+    inner = ClassificationTrainer(create_model("lr", output_dim=10))
+    wrapped = LoRATrainer(inner, rank=4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 784), jnp.float32)
+    gv_in = inner.init(jax.random.PRNGKey(0), x[:1])
+    gv_wr = wrapped.init(jax.random.PRNGKey(0), x[:1])
+    assert LORA_COLLECTION in gv_wr
+    assert _bitwise_equal(gv_wr[LORA_COLLECTION], gv_in["params"])
+    assert _bitwise_equal(inner.apply(gv_in, x), wrapped.apply(gv_wr, x))
+
+
+def test_lora_rank_zero_is_structurally_off():
+    """rank 0 must return the SAME trainer object — no wrapper, no new
+    collections, the exact legacy trace."""
+    trainer = ClassificationTrainer(create_model("lr", output_dim=10))
+    assert maybe_wrap_lora(trainer, _cfg(lora_rank=0)) is trainer
+    assert maybe_wrap_lora(trainer, _cfg()) is trainer
+    # and double-wrapping is refused too
+    wrapped = maybe_wrap_lora(trainer, _cfg(lora_rank=4))
+    assert maybe_wrap_lora(wrapped, _cfg(lora_rank=4)) is wrapped
+
+
+def test_lm_head_kernel_gets_no_adapter():
+    """DEFAULT_TARGETS excludes the [d_model, vocab] head (peft's
+    "all-linear" convention) — the one adapter that would dwarf every block
+    adapter combined and cap the adapter-only wire shrink."""
+    trainer = LoRATrainer(
+        NWPTrainer(create_model("transformer_nwp", output_dim=200)), rank=4)
+    gv = jax.eval_shape(lambda: trainer.init(jax.random.PRNGKey(0),
+                                             jnp.zeros((2, 8), jnp.int32)))
+    assert "lm_head" in gv[LORA_COLLECTION]
+    assert "lm_head" not in gv["params"]
+    assert gv["params"]  # block kernels did match
+
+
+# ----------------------------------------------------- frozen-base invariance
+
+def test_frozen_base_bitwise_invariant_across_rounds(ds8):
+    api = _lora_api(ds8, _cfg(comm_round=3, lora_rank=4))
+    base0 = jax.device_get(api.global_variables[LORA_COLLECTION])
+    adapters0 = jax.device_get(api.global_variables["params"])
+    hist = api.train()
+    assert _bitwise_equal(api.global_variables[LORA_COLLECTION], base0)
+    assert not _bitwise_equal(api.global_variables["params"], adapters0)
+    assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+
+
+# ------------------------------------------- checkpoint resume + guard rollback
+
+def test_adapter_only_checkpoint_resume_is_bitwise(ds8, tmp_path):
+    """ckpt-at-2 -> NEW api -> resume -> finish == straight 4-round run,
+    bitwise on params, base AND aggregator state; the on-disk tree holds
+    adapters only (the base is a pure function of cfg.seed, re-derived by
+    the fresh api at construction)."""
+    straight = _lora_api(ds8, _cfg(comm_round=4, lora_rank=4))
+    straight.train()
+
+    d = str(tmp_path / "ckpt")
+    first = _lora_api(ds8, _cfg(comm_round=2, lora_rank=4))
+    first.train(ckpt_dir=d, ckpt_every=100)
+    assert all_checkpoint_steps(d) == [2]
+    # what went to disk is what _ckpt_tree hands save_checkpoint:
+    # adapters-only variables, never the base
+    saved = first._ckpt_tree()["variables"]
+    assert LORA_COLLECTION not in saved
+    paths = [jax.tree_util.keystr(p) for p, _ in
+             jax.tree_util.tree_flatten_with_path(saved)[0]]
+    assert any("lora_A" in p for p in paths)
+
+    resumed = _lora_api(ds8, _cfg(comm_round=4, lora_rank=4))
+    resumed.train(ckpt_dir=d, ckpt_every=100)
+    assert _bitwise_equal(resumed.global_variables,
+                          straight.global_variables)
+    assert _bitwise_equal(resumed.agg_state, straight.agg_state)
+
+
+class _RejectOnce:
+    max_retries = 2
+
+    def __init__(self, bad_round=1):
+        self.bad_round = bad_round
+        self.fired = False
+
+    def inspect(self, round_idx, loss, global_variables=None):
+        if round_idx == self.bad_round and not self.fired:
+            self.fired = True
+            return GuardVerdict(False, "forced test rejection")
+        return GuardVerdict(True, "")
+
+
+def test_guard_rollback_restores_adapters_bitwise(ds8):
+    """Rollback restores the in-memory snapshot (adapters + agg state) and
+    re-attaches the live base: two same-seed guarded runs are byte-identical
+    end to end, and the base never moves."""
+    runs = []
+    for _ in range(2):
+        api = _lora_api(ds8, _cfg(comm_round=3, lora_rank=4))
+        base0 = jax.device_get(api.global_variables[LORA_COLLECTION])
+        api.train(guard=_RejectOnce(bad_round=1))
+        assert _bitwise_equal(api.global_variables[LORA_COLLECTION], base0)
+        runs.append(api)
+    assert runs[0].history[1]["guard_retries"] == 1  # the rollback fired
+    assert _bitwise_equal(runs[0].global_variables,
+                          runs[1].global_variables)
+    assert _bitwise_equal(runs[0].agg_state, runs[1].agg_state)
+
+
+# ------------------------------------------------- codec + buffered composition
+
+def test_lora_topk_codec_e2e_on_buffered_drive(ds8):
+    """The full stack in one drive: LoRA adapters through the FedBuff
+    admit/commit loop with the top-k codec on the wire. Base frozen, loss
+    finite and improving — the codec residual tree is adapters-shaped."""
+    api = _lora_api(ds8, _cfg(comm_round=3, lora_rank=4, buffer_size=8,
+                              update_codec="topk", codec_k=16))
+    base0 = jax.device_get(api.global_variables[LORA_COLLECTION])
+    hist = api.train()
+    assert _bitwise_equal(api.global_variables[LORA_COLLECTION], base0)
+    assert np.isfinite(hist[-1]["Test/Loss"])
+    assert hist[-1]["Test/Loss"] < hist[0]["Test/Loss"]
+    # the codec really was on the wire, and the buffer rows it compressed
+    # are the WIRE tree: adapters only, no base (engine strips inside vmap)
+    assert api.codec is not None and api.codec.name.startswith("topk")
+    rows = api._buffer["vars"]
+    assert LORA_COLLECTION not in rows
+    assert jax.tree.structure(rows) == jax.tree.structure(
+        strip_lora_base(api.global_variables))
+
+
+# ------------------------------------------------ shard_step (GSPMD) contracts
+
+@pytest.fixture(scope="module")
+def mesh24():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_tensor_mesh(4)
+
+
+def _round_setup(ds8, lora_rank=0):
+    cfg = _cfg(epochs=1, tensor_shards=4, shard_step=True,
+               lora_rank=lora_rank)
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=ds8.class_num)),
+        cfg)
+    agg = make_aggregator("fedavg", cfg)
+    rng = jax.random.PRNGKey(0)
+    gv = trainer.init(rng, jnp.asarray(ds8.train.x[:1, 0]))
+    state = agg.init_state(gv)
+    x, y, counts = ds8.train.select(np.arange(8))
+    # ONE minibatch step per client: sequential SGD compounds the
+    # partitioner's per-step reassociation error multiplicatively, so the
+    # tolerance pin holds the single-step error, not the compounded tail
+    data = (jnp.asarray(x[:, :8]), jnp.asarray(y[:, :8]),
+            jnp.full((8,), 8, jnp.int32))
+    return cfg, trainer, agg, gv, state, data, rng
+
+
+@pytest.mark.parametrize("lora_rank", [0, 4])
+def test_shard_step_round_allclose_to_vmap_engine(mesh24, ds8, lora_rank):
+    """The documented trade: GSPMD reassociates float contractions, so the
+    activation-sharded round matches the vmap engine within 1e-6 (not
+    bitwise). Composes with LoRA — the frozen base stays bitwise."""
+    cfg, trainer, agg, gv, state, (x, y, counts), rng = _round_setup(
+        ds8, lora_rank)
+    sh = TensorSharding.for_model(mesh24, "lr")
+    rf = build_tensor_step_round_fn(trainer, cfg, agg, sh,
+                                    donate_state=False)
+    vmap_rf = build_round_fn(trainer, cfg, agg)
+
+    g1, s1, m1 = rf(sh.place(gv), sh.place(state), x, y, counts, rng)
+    g2, s2, m2 = vmap_rf(gv, state, x, y, counts, rng)
+    assert _max_abs_delta(g1, g2) < 1e-6
+    assert _max_abs_delta(s1, s2) < 1e-6
+    for k in m1:
+        assert abs(float(m1[k]) - float(m2[k])) < 1e-3
+    if lora_rank:
+        assert _bitwise_equal(g1[LORA_COLLECTION], gv[LORA_COLLECTION])
+
+
+def test_tensor_shards_one_is_bit_identical(ds8):
+    """At tensor_shards=1 the constraint scope is structurally off and the
+    step program IS the plain jitted vmap step — bitwise, on a 1x1 mesh so
+    no partitioner touches the arithmetic."""
+    cfg = _cfg(epochs=1, tensor_shards=1)
+    trainer = ClassificationTrainer(
+        create_model("lr", output_dim=ds8.class_num))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("clients", "tensor"))
+    sh = TensorSharding.for_model(mesh, "lr")
+    gv = trainer.init(jax.random.PRNGKey(0), jnp.asarray(ds8.train.x[:1, 0]))
+    x, y, counts = ds8.train.select(np.arange(8))
+    x, y, counts = jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts)
+    rng = jax.random.PRNGKey(7)
+
+    step_fn = build_tensor_step_fn(trainer, cfg, sh)
+    local_update = build_local_update(trainer, cfg)
+
+    def plain(gv, x, y, counts, rng):
+        crngs = jax.random.split(rng, x.shape[0])
+        return jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
+            gv, x, y, counts, crngs)
+
+    r_sh = step_fn(gv, x, y, counts, rng)
+    r_pl = jax.jit(plain)(gv, x, y, counts, rng)
+    assert _bitwise_equal(r_sh.variables, r_pl.variables)
+    assert _bitwise_equal(r_sh.metrics, r_pl.metrics)
+
+
+def test_batched_rank_constraint_spec_raises_at_trace():
+    """Constraint specs are written at the rank the MODEL sees; the client
+    vmap prepends its batch dim automatically. A spec written at the
+    batched rank over-ranks the actual intermediate and must fail loudly at
+    trace time, not silently mis-shard (parallel/activations.py)."""
+    mesh = make_tensor_mesh(4)
+    cfg = FedConfig(model="transformer_nwp", batch_size=2, epochs=1,
+                    tensor_shards=4)
+    trainer = NWPTrainer(create_model("transformer_nwp", output_dim=200))
+    sh = TensorSharding.for_model(mesh, "transformer_nwp")
+    gv = jax.eval_shape(lambda: trainer.init(jax.random.PRNGKey(0),
+                                             jnp.zeros((2, 16), jnp.int32)))
+    SDS = jax.ShapeDtypeStruct
+    args = (gv, SDS((2, 4, 16), jnp.int32), SDS((2, 4, 16), jnp.int32),
+            SDS((2,), jnp.int32), SDS((2,), jnp.uint32))
+    bad_rules = {"attn_qkv": PS(None, None, None, "tensor")}  # batched rank
+    step_bad = build_tensor_step_fn(trainer, cfg, sh,
+                                    activation_rules=bad_rules)
+    with pytest.raises(ValueError, match="rank at least"):
+        step_bad.lower(*args)
+
+
+# --------------------------------------------------- the per-device memory win
+
+def test_step_peak_memory_shrinks_at_four_shards():
+    """XLA's own memory_analysis: per-device peak (temp + args + out) of the
+    activation-sharded transformer step is >=2x below the replicated twin at
+    4 shards. COMMS_BUDGET.json pins the tighter <=0.5x ratio at the full
+    NWP vocab in CI; this is the suite-local floor at a fast vocab."""
+    mesh = make_tensor_mesh(4)
+    cfg = FedConfig(model="transformer_nwp", batch_size=2, epochs=1,
+                    dtype="float32", tensor_shards=4)
+    trainer = NWPTrainer(create_model("transformer_nwp", output_dim=2000))
+    gv = jax.eval_shape(lambda: trainer.init(jax.random.PRNGKey(0),
+                                             jnp.zeros((2, 16), jnp.int32)))
+    SDS = jax.ShapeDtypeStruct
+    tail = (SDS((2, 4, 16), jnp.int32), SDS((2, 4, 16), jnp.int32),
+            SDS((2,), jnp.int32), SDS((2,), jnp.uint32))
+
+    def peak(step_fn):
+        ma = step_fn.lower(gv, *tail).compile().memory_analysis()
+        return (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                + ma.output_size_in_bytes)
+
+    sharded = peak(build_tensor_step_fn(
+        trainer, cfg, TensorSharding.for_model(mesh, "transformer_nwp")))
+    replicated = peak(build_tensor_step_fn(
+        trainer, cfg, TensorSharding(mesh, tuple(REPLICATED_RULES)),
+        activation_rules=None))
+    assert replicated / sharded >= 2.0, \
+        f"peak shrink {replicated / sharded:.2f}x < 2x " \
+        f"(sharded {sharded}B, replicated {replicated}B)"
+
+
+# -------------------------------------------------------- committed wire pins
+
+def test_committed_budgets_pin_lora_wire_shrink():
+    """The >=50x rank-8 adapter-only param-byte shrink and the
+    lora+topk-strictly-smaller stacking, read from the committed
+    COMMS_BUDGET.json (run_comms re-measures and gates both in CI)."""
+    with open(os.path.join(_REPO, "COMMS_BUDGET.json")) as f:
+        budgets = json.load(f)
+    full = budgets["tensor.round[tformer,f32,fedavg,2x4]"]
+    lora = budgets["tensor.round[tformer,f32,fedavg,2x4,lora8]"]
+    topk = budgets["tensor.round[tformer,f32,fedavg,2x4,topk64]"]
+    stack = budgets["tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]"]
+    assert full["param_bytes"] / lora["param_bytes"] >= 50.0
+    assert stack["collective_bytes"] < lora["collective_bytes"]
+    assert stack["collective_bytes"] < topk["collective_bytes"]
+    step = budgets["tensor.step[tformer,f32,2x4]"]
+    repl = budgets["tensor.step[tformer,f32,2x4,replicated]"]
+    # both step twins pin ZERO user collectives (GSPMD resharding is
+    # bounded by the peak budget, not counted here)
+    assert step["collective_count"] == repl["collective_count"] == 0
+    assert step["peak_bytes"] <= 0.5 * repl["peak_bytes"]

@@ -5,7 +5,14 @@ SGD lr .1 clip 1.0, bf16) — the bench.py workload. Prints ms/round for both
 paths and the fused/engine speedup, plus a numeric cross-check of one
 dropout-free round (compiled TPU kernel vs engine) to guard against Mosaic
 miscompilation at the real shapes.
-"""
+
+Also runs the ENGINE-SEAM A/B (ROADMAP 1a landed): the same
+`engine.build_round_fn` call with `cfg.fused_kernel` flipped — the exact
+program a `--fused_kernel` CLI run traces (COMPILE_BUDGET.json pins it as
+engine.round[cnn,f32,fedavg,fused]) — under an enforced allclose contract
+on a dropout-free CNN_DropOut twin. Off-TPU the kernel runs in pallas
+interpret mode: numerics-honest, no speed claim (the printed timing says
+cpu_interpret and must not be read as a speedup)."""
 
 import time
 
@@ -74,6 +81,40 @@ def main():
     print(f"numeric check (f32, no dropout): max abs param diff = {max(errs):.3e}")
     print(f"  engine metrics {jax.tree.map(float, m_e)}")
     print(f"  fused  metrics {jax.tree.map(float, m_f)}")
+
+    # ---- engine-seam A/B: build_round_fn with cfg.fused_kernel flipped ----
+    from fedml_tpu.models.cnn import CNN_DropOut
+
+    tr_seam = ClassificationTrainer(
+        CNN_DropOut(output_dim=62, drop1=0.0, drop2=0.0))
+    cfg_seam = FedConfig(batch_size=20, epochs=1, lr=0.1,
+                         client_optimizer="sgd", client_num_per_round=10,
+                         dtype="float32", shuffle=False, grad_clip=1.0)
+    gv_seam = tr_seam.init(jax.random.PRNGKey(0), x[0, :1])
+    arms = {}
+    for name, fused in (("engine", False), ("fused", True)):
+        rf = build_round_fn(tr_seam, cfg_seam.replace(fused_kernel=fused),
+                            agg)
+        g, _, m = rf(gv_seam, agg.init_state(gv_seam), x, y, counts, key)
+        readback(g)  # compile + settle outside the timed window
+        t0 = time.perf_counter()
+        g, _, m = rf(gv_seam, agg.init_state(gv_seam), x, y, counts, key)
+        readback(g)
+        arms[name] = {"g": g, "ms": (time.perf_counter() - t0) * 1e3,
+                      "loss": float(m["loss_sum"])}
+    seam_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+        jax.tree.leaves(arms["engine"]["g"]), jax.tree.leaves(arms["fused"]["g"])))
+    on_tpu = jax.default_backend() == "tpu"
+    mode = "compiled" if on_tpu else "cpu_interpret (no speed claim)"
+    print(f"engine-seam A/B (cfg.fused_kernel flip, f32 drop-free): "
+          f"max abs param diff = {seam_err:.3e}  [{mode}]")
+    for name in ("engine", "fused"):
+        print(f"  {name}: {arms[name]['ms']:.1f} ms/round, "
+              f"loss_sum {arms[name]['loss']:.4f}")
+    if not seam_err < 1e-4:
+        raise SystemExit(
+            f"fused-kernel allclose contract violated: {seam_err:.3e} >= 1e-4 "
+            f"— the --fused_kernel trajectory diverged from the engine")
 
     # ---- timing -----------------------------------------------------------
     scan_rounds, reps = 20, 3

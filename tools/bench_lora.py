@@ -1,0 +1,148 @@
+"""Federated-LoRA A/B: wire bytes per round and rounds/s, full vs rank-8.
+
+Two halves, one artifact (BENCH_LORA_rNN.json), mirroring bench_codec.py:
+
+wire bytes   read from the committed COMMS_BUDGET.json — the transformer
+             tensor.round twins' `param_bytes` (the federated tree one
+             client ships: the >=50x adapter-only shrink the comms gate
+             pins) and `collective_bytes` (what one round actually moves on
+             the mesh) for full / lora8 / topk64 / lora8+topk64. Budgets
+             are the source of truth on purpose: a bench re-measuring
+             bytes could drift from the gated values; this artifact can't.
+
+throughput   the synchronous drive (mnist/lr, 8 clients) run once per arm
+             (lora_rank 0 / 8) on the SAME seeded workload, rounds per
+             wall-second. On one CPU host the adapter path saves no wall
+             time (the base matmuls still run; the wire it shrinks is
+             intra-host) — the byte shrink, not rounds/s, is the headline,
+             and `cpu_capped` says so honestly.
+
+Env knobs:
+  BENCH_LORA_ROUNDS=20                 drive rounds per throughput arm
+  BENCH_LORA_OUT=BENCH_LORA_r01.json   '' to skip the artifact
+
+The perf gate skips BENCH_LORA_* by name (telemetry/report.py
+_GATE_SKIP_PREFIXES) — an adapter A/B is not a drive-throughput baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLIENTS, BATCH = 8, 8
+
+# the transformer tensor.round family in COMMS_BUDGET.json
+WIRE_PROGRAMS = {
+    "full": "tensor.round[tformer,f32,fedavg,2x4]",
+    "lora8": "tensor.round[tformer,f32,fedavg,2x4,lora8]",
+    "topk64": "tensor.round[tformer,f32,fedavg,2x4,topk64]",
+    "lora8_topk64": "tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]",
+}
+
+
+def wire_bytes_table(root: str) -> dict:
+    """Federated-tree bytes (param_bytes) and per-round collective bytes for
+    each arm, with shrink ratios against the full-model round — straight
+    from the committed budgets the `--comms` gate re-measures."""
+    with open(os.path.join(root, "COMMS_BUDGET.json")) as f:
+        budgets = json.load(f)
+    full = budgets[WIRE_PROGRAMS["full"]]
+    table = {}
+    for arm, name in WIRE_PROGRAMS.items():
+        b = budgets[name]
+        table[arm] = {
+            "param_bytes": b["param_bytes"],
+            "collective_bytes": b["collective_bytes"],
+            "param_shrink_x": round(
+                full["param_bytes"] / b["param_bytes"], 2),
+            "wire_shrink_x": round(
+                full["collective_bytes"] / b["collective_bytes"], 2),
+        }
+    return table
+
+
+def run_throughput_arm(ds, rounds: int, lora_rank: int) -> dict:
+    """One synchronous drive at the given rank; rounds per wall-second."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.lora import maybe_wrap_lora
+    from fedml_tpu.models.registry import create_model
+
+    cfg = FedConfig(dataset="mnist", model="lr", comm_round=rounds,
+                    batch_size=BATCH, epochs=1, lr=0.05,
+                    client_num_in_total=CLIENTS,
+                    client_num_per_round=CLIENTS, seed=0, ci=1,
+                    frequency_of_the_test=10**9, lora_rank=lora_rank)
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=ds.class_num)),
+        cfg)
+    api = FedAvgAPI(ds, cfg, trainer)
+    t0 = time.perf_counter()
+    hist = api.train()
+    wall_s = time.perf_counter() - t0
+    return {
+        "lora_rank": lora_rank,
+        "rounds": rounds,
+        "wall_s": round(wall_s, 4),
+        "rounds_per_sec_arm": round(rounds / wall_s, 2),
+        "final_test_loss": round(float(hist[-1]["Test/Loss"]), 5),
+    }
+
+
+def main() -> None:
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu.data.registry import load_dataset
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rounds = int(os.environ.get("BENCH_LORA_ROUNDS", 20))
+    ds = load_dataset("mnist", client_num_in_total=CLIENTS,
+                      partition_method="homo", seed=0)
+
+    # warmup compiles both arms' programs outside the timed windows
+    for rank in (0, 8):
+        run_throughput_arm(ds, 2, rank)
+    arms = {f"rank{rank}": run_throughput_arm(ds, rounds, rank)
+            for rank in (0, 8)}
+
+    cores = os.cpu_count() or 1
+    parsed = {
+        "metric": "lora_wire_bytes_and_rounds_per_sec",
+        "unit": "federated-tree/collective bytes per round (from "
+                "COMMS_BUDGET.json) and drive rounds per wall-second per "
+                "lora_rank arm",
+        "wire_bytes_per_round": wire_bytes_table(root),
+        "arms": arms,
+        "lora_overhead_x": round(
+            arms["rank0"]["rounds_per_sec_arm"]
+            / max(arms["rank8"]["rounds_per_sec_arm"], 1e-9), 3),
+        "rounds": rounds, "clients": CLIENTS, "batch_size": BATCH,
+        "model": "lr",
+        "platform": jax.devices()[0].platform,
+        "cpu_cores": cores,
+        "cpu_capped": jax.devices()[0].platform == "cpu",
+    }
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_LORA_OUT", "BENCH_LORA_r01.json")
+    if out:
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": rounds,
+                       "cmd": "python tools/bench_lora.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
