@@ -144,6 +144,27 @@ assert not ok and not skipped, msg
 print("OK compile gate trips on one compile over the pipelined ceiling")
 EOF
 
+echo "== compile budget self-test: the superstep pin must be live"
+# enumerate the finetune drive (which reaches the K=4 superstep program)
+# against a doctored budget table with the superstep pin removed —
+# check_budgets must produce a reachable-but-not-budgeted finding, proving
+# the new engine.superstep entry is a live gate, not dead JSON
+python - <<'EOF'
+import json
+from fedml_tpu.analysis.compile_engine import check_budgets
+from fedml_tpu.analysis.targets import enumerate_drive_programs
+budgets = json.load(open("COMPILE_BUDGET.json"))
+pin = "engine.superstep[lr,f32,fedavg,k4]"
+measured = {"finetune": enumerate_drive_programs("finetune")}
+assert pin in measured["finetune"], "superstep program not enumerated"
+assert not check_budgets(measured, budgets), "committed budgets out of date"
+del budgets["finetune"]["programs"][pin]
+findings = check_budgets(measured, budgets)
+assert any(pin in f.message and "not budgeted" in f.message
+           for f in findings), findings
+print("OK compile budget trips when the superstep pin is removed")
+EOF
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 
@@ -218,6 +239,61 @@ python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
 assert_summary "Test/Loss" 0 10
 assert_summary "Test/Acc" 0.0 1.0
 assert_summary "quarantined_count" 1 7
+
+echo "== superstep smoke (--rounds_per_dispatch 4: K fused rounds, chaos on)"
+# K=4 depth-0 chaos drive at the CLI level: round 0 is the eval boundary
+# (eager), rounds 1-3 run as ONE fused dispatch with the [K, C] chaos
+# masks applied in-graph; the drive must survive and report sane metrics
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 4 \
+  --epochs 1 --batch_size 4 --frequency_of_the_test 100 \
+  --chaos 1 --chaos_seed 7 --chaos_drop_rate 0.3 --chaos_nan_rate 0.4 \
+  --rounds_per_dispatch 4
+assert_summary "Test/Loss" 0 10
+assert_summary "Test/Acc" 0.0 1.0
+assert_summary "chaos_dropped" 0 7
+
+echo "== superstep byte-equality check: K=4 fused == K=1 eager, bitwise"
+python - <<'EOF'
+# API-level twin of the CLI smoke: the fused drive must commit final params
+# BYTE-equal to the eager drive under the same seeded chaos, and the trace
+# must carry superstep_committed events covering the fused chunks
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from fedml_tpu import telemetry
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+
+ds = load_dataset("mnist", client_num_in_total=8, partition_method="homo")
+
+def run(k):
+    cfg = FedConfig(comm_round=5, epochs=1, batch_size=4, lr=0.05,
+                    client_num_in_total=8, client_num_per_round=8,
+                    frequency_of_the_test=100, rounds_per_dispatch=k)
+    api = FedAvgAPI(ds, cfg, ClassificationTrainer(
+        create_model("lr", output_dim=10)))
+    tracer = telemetry.Tracer()
+    api.train(chaos=FaultPlan(seed=7, drop_rate=0.3, nan_rate=0.4),
+              tracer=tracer)
+    return api, tracer
+
+eager, _ = run(1)
+fused, tracer = run(4)
+for a, b in zip(jax.tree.leaves(eager.global_variables),
+                jax.tree.leaves(fused.global_variables)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+        "superstep params diverged from the eager drive"
+committed = tracer.find_events("superstep_committed")
+assert committed and sum(e["rounds"] for e in committed) == 4, committed
+print("OK superstep K=4 byte-equal to eager;",
+      len(committed), "superstep_committed event(s)")
+EOF
 
 echo "== federated LoRA smoke (--lora_rank 8: adapter-only rounds, CLI level)"
 # two rounds with rank-8 adapters on the lr base — the CLI seam wraps the

@@ -351,6 +351,63 @@ def cohort_stats(global_variables, result: LocalResult) -> dict:
     }
 
 
+def _round_core(batched_update, aggregator, collect_stats: bool) -> Callable:
+    """The ONE synchronous-round body, shared by build_round_fn_from_update
+    (one round per dispatch) and build_superstep_fn_from_update (K rounds
+    per dispatch, scanned). Both builders trace exactly this function, so
+    the superstep's bit-identity contract with the eager loop holds by
+    construction — there is no second round definition to drift.
+
+    Returns core(gv, agg_state, x, y, counts, rng, participation) ->
+    (new_gv, new_state, metrics, stats-or-None); `participation=None`
+    traces the legacy unmasked program, an array arms the quarantine stage
+    (see build_round_fn_from_update's docstring for the full contract).
+    """
+    # function-level import: aggregators.make_server_optimizer imports
+    # engine.torch_adagrad, so the modules must not need each other at
+    # import time
+    from fedml_tpu.algorithms.aggregators import quarantine_stage
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
+
+    def core(global_variables, agg_state, x, y, counts, rng, participation):
+        crngs = jax.random.split(rng, x.shape[0])
+        result = batched_update(global_variables, x, y, counts, crngs)
+        # ledger stats come from the RAW results (pre-quarantine) so the
+        # poisoned rows aggregation zeroes below stay visible per-client
+        stats = cohort_stats(global_variables, result) if collect_stats \
+            else None
+        weights = counts.astype(jnp.float32)
+        if participation is None:
+            new_global, new_state = aggregator(
+                global_variables, result, weights, rng, agg_state
+            )
+            # LoRA: aggregation ran adapters-only (results are stripped);
+            # the server's frozen base re-attaches untouched (no-op when
+            # the trainer isn't wrapped)
+            new_global = attach_lora_base(new_global, global_variables)
+            # per-client metric sums -> federation totals
+            metrics = {k: v.sum() for k, v in result.metrics.items()}
+            return new_global, new_state, metrics, stats
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator(
+            global_variables, result, weights, rng, agg_state
+        )
+        any_alive = jnp.any(alive)
+        # the all-dead fallback must match the aggregator output's
+        # (adapters-only under LoRA) structure; base re-attaches after
+        new_global = tree_where(any_alive, new_global,
+                                strip_lora_base(global_variables))
+        new_state = tree_where(any_alive, new_state, agg_state)
+        new_global = attach_lora_base(new_global, global_variables)
+        metrics = {k: v.sum() for k, v in result.metrics.items()}
+        metrics["participated_count"] = alive.sum().astype(jnp.float32)
+        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        return new_global, new_state, metrics, stats
+
+    return core
+
+
 def build_round_fn_from_update(batched_update, aggregator,
                                donate_data: bool = False,
                                collect_stats: bool = False) -> Callable:
@@ -382,49 +439,12 @@ def build_round_fn_from_update(batched_update, aggregator,
     never changes the traced program, only buffer aliasing, so donated and
     undonated rounds are bit-identical.
     """
-    # function-level import: aggregators.make_server_optimizer imports
-    # engine.torch_adagrad, so the modules must not need each other at
-    # import time
-    from fedml_tpu.algorithms.aggregators import quarantine_stage
-    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
+    core = _round_core(batched_update, aggregator, collect_stats)
 
     def round_fn(global_variables, agg_state, x, y, counts, rng,
                  participation=None):
-        crngs = jax.random.split(rng, x.shape[0])
-        result = batched_update(global_variables, x, y, counts, crngs)
-        # ledger stats come from the RAW results (pre-quarantine) so the
-        # poisoned rows aggregation zeroes below stay visible per-client
-        stats = cohort_stats(global_variables, result) if collect_stats \
-            else None
-        weights = counts.astype(jnp.float32)
-        if participation is None:
-            new_global, new_state = aggregator(
-                global_variables, result, weights, rng, agg_state
-            )
-            # LoRA: aggregation ran adapters-only (results are stripped);
-            # the server's frozen base re-attaches untouched (no-op when
-            # the trainer isn't wrapped)
-            new_global = attach_lora_base(new_global, global_variables)
-            # per-client metric sums -> federation totals
-            metrics = {k: v.sum() for k, v in result.metrics.items()}
-            if collect_stats:
-                return new_global, new_state, metrics, stats
-            return new_global, new_state, metrics
-        result, weights, alive, quarantined = quarantine_stage(
-            result, weights, participation)
-        new_global, new_state = aggregator(
-            global_variables, result, weights, rng, agg_state
-        )
-        any_alive = jnp.any(alive)
-        # the all-dead fallback must match the aggregator output's
-        # (adapters-only under LoRA) structure; base re-attaches after
-        new_global = tree_where(any_alive, new_global,
-                                strip_lora_base(global_variables))
-        new_state = tree_where(any_alive, new_state, agg_state)
-        new_global = attach_lora_base(new_global, global_variables)
-        metrics = {k: v.sum() for k, v in result.metrics.items()}
-        metrics["participated_count"] = alive.sum().astype(jnp.float32)
-        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        new_global, new_state, metrics, stats = core(
+            global_variables, agg_state, x, y, counts, rng, participation)
         if collect_stats:
             return new_global, new_state, metrics, stats
         return new_global, new_state, metrics
@@ -734,6 +754,105 @@ def build_multi_round_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int) -
     """R vmap-engine rounds as one jitted lax.scan."""
     return build_multi_round_fn_from_update(
         _vmapped_update(trainer, cfg), cfg, aggregator, num_rounds)
+
+
+def build_superstep_fn_from_update(batched_update, cfg: FedConfig,
+                                   aggregator, num_rounds: int, *,
+                                   client_num_in_total: int,
+                                   collect_stats: bool = False,
+                                   chaos_armed: bool = False,
+                                   in_graph_sampling: bool = False) -> Callable:
+    """K federated rounds as ONE jitted `lax.scan` over `_round_core` —
+    BIT-identical to K eager `build_round_fn_from_update` rounds on the
+    `rng = fold_in(base_rng, round_idx)` stream (tests/test_superstep.py),
+    unlike build_multi_round_fn_from_update above, whose in-graph
+    `jax.random.permutation` sampling is a different seeded trajectory.
+
+    Per-round traced inputs arrive as a `per_round` dict of [K]-leading
+    arrays (the scan's xs):
+
+    - ``round_idx`` [K] int32 — folded into base_rng per round, the same
+      stream the eager drive uses.
+    - ``idx`` [K, C] int32 (default sampler, host-precomputed) or
+      ``keys`` [K, 4, 2] uint32 (``in_graph_sampling=True``: the Feistel
+      key schedule; indices are recomputed in-graph by
+      algorithms/sampling.py, bitwise equal to the host sampler).
+    - with ``chaos_armed``: ``nan`` / ``corrupt`` / ``participation``
+      [K, C] bool masks from the seeded FaultPlan. NaN-fill and the
+      x*1e3+7.0 corruption are applied in-graph post-gather, replaying
+      chaos.apply_faults' float semantics op-for-op (the masks are
+      disjoint by construction, so application order cannot matter);
+      int-dtype corruption is data-dependent on the host and is NOT
+      expressible here — the drive falls back to eager for it.
+
+    The cohort is gathered from the device-resident whole store
+    (data.packed_store.resident_train_arrays) inside the scan, so no host
+    work happens between rounds; metrics (and `collect_stats` ledger rows)
+    come back with a leading [K] axis, letting RoundRecordLog flush K
+    rounds with one deferred device_get.
+
+    Superstep(gv, agg_state, data_x, data_y, data_counts, base_rng,
+    per_round) -> (gv, agg_state, metrics[, stats]). The codec residual
+    (CodecAggregator state) and fedopt momenta ride the scan carry in
+    agg_state; LoRA base re-attachment happens per round inside the core.
+    """
+    if num_rounds < 1:
+        raise ValueError(f"num_rounds must be >= 1, got {num_rounds}")
+    core = _round_core(batched_update, aggregator, collect_stats)
+    cohort = min(cfg.client_num_per_round, int(client_num_in_total))
+    if in_graph_sampling:
+        from fedml_tpu.algorithms.sampling import feistel_cohort_in_graph
+
+    def superstep(global_variables, agg_state, data_x, data_y, data_counts,
+                  base_rng, per_round):
+        def body(carry, pr):
+            gv, st = carry
+            rng = jax.random.fold_in(base_rng, pr["round_idx"])
+            if in_graph_sampling:
+                idx = feistel_cohort_in_graph(pr["keys"],
+                                              int(client_num_in_total),
+                                              cohort)
+            else:
+                idx = pr["idx"]
+            xs = jnp.take(data_x, idx, axis=0)
+            ys = jnp.take(data_y, idx, axis=0)
+            cs = jnp.take(data_counts, idx, axis=0)
+            participation = None
+            if chaos_armed:
+                mshape = (cohort,) + (1,) * (xs.ndim - 1)
+                xs = jnp.where(pr["corrupt"].reshape(mshape),
+                               xs * 1e3 + 7.0, xs)
+                xs = jnp.where(pr["nan"].reshape(mshape), jnp.nan, xs)
+                participation = pr["participation"]
+            gv, st, metrics, stats = core(gv, st, xs, ys, cs, rng,
+                                          participation)
+            return (gv, st), (metrics, stats)
+
+        (gv, st), (metrics, stats) = jax.lax.scan(
+            body, (global_variables, agg_state), per_round)
+        if collect_stats:
+            return gv, st, metrics, stats
+        return gv, st, metrics
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program=f"engine.superstep[k{num_rounds}]",
+                   donate=False, k=num_rounds)
+    return jax.jit(superstep)
+
+
+def build_superstep_fn(trainer, cfg: FedConfig, aggregator, num_rounds: int,
+                       *, client_num_in_total: int,
+                       collect_stats: bool = False,
+                       chaos_armed: bool = False,
+                       in_graph_sampling: bool = False) -> Callable:
+    """K vmap-engine rounds as one jitted scan, bit-identical to the eager
+    drive (see build_superstep_fn_from_update). The caller passes the SAME
+    aggregator instance its eager round_fn closes over (codec-wrapped and
+    all), so agg_state trees line up between the fused and eager paths."""
+    return build_superstep_fn_from_update(
+        _vmapped_update(trainer, cfg), cfg, aggregator, num_rounds,
+        client_num_in_total=client_num_in_total, collect_stats=collect_stats,
+        chaos_armed=chaos_armed, in_graph_sampling=in_graph_sampling)
 
 
 def build_eval_fn(trainer) -> Callable:

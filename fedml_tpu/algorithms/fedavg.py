@@ -9,6 +9,7 @@ the device mesh (fedml_tpu.parallel) is the "cluster".
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 from collections import deque
@@ -137,6 +138,18 @@ class FedAvgAPI(Checkpointable):
                 "program-level building block — combine buffer_size with "
                 "neither backend='shard_map', tensor_shards, nor "
                 "silo_threshold")
+        if config.rounds_per_dispatch > 1 and (
+                config.pipeline_depth > 0 or config.buffer_size > 0
+                or config.backend != "vmap" or config.tensor_shards > 0
+                or config.silo_threshold > 0 or config.fused_kernel):
+            raise ValueError(
+                "rounds_per_dispatch (the multi-round superstep) fuses K "
+                "rounds into ONE program on the single-chip vmap engine — "
+                "there is no per-round host gap left for the pipeline or "
+                "buffer to exploit, and the sharded/silo/fused lowerings "
+                "have no superstep twin; combine it with none of "
+                "pipeline_depth / buffer_size / backend='shard_map' / "
+                "tensor_shards / silo_threshold / fused_kernel")
         if config.silo_threshold > 0 and config.backend == "shard_map":
             raise ValueError(
                 "silo_threshold (the single-chip silo-grouped conv path) "
@@ -227,6 +240,12 @@ class FedAvgAPI(Checkpointable):
         self.client_eval_fn = build_client_eval_fn(model_trainer)
         self._fed_eval_fn = build_federation_eval_fn(model_trainer)
         self._resident_cache = None
+        # superstep drive state: jitted K-round programs keyed by
+        # (k_eff, chaos_armed, in_graph_sampling), and the device-resident
+        # whole-train-store arrays they gather cohorts from (None until
+        # first use; () = residency unavailable, eager fallback)
+        self._superstep_cache: dict = {}
+        self._resident_train = None
         self.history: list[dict[str, Any]] = []
         # The stage seam: every cohort — eager or pipelined, any backing
         # store — reaches the device through this one callable
@@ -359,6 +378,14 @@ class FedAvgAPI(Checkpointable):
                     self._train_pipelined(start_round, ckpt_dir, ckpt_every,
                                           metrics_logger, chaos, guard,
                                           tracer, ledger)
+                elif cfg.rounds_per_dispatch > 1:
+                    # multi-round superstep: K rounds per jitted dispatch,
+                    # bit-identical to the eager loop (tests/test_superstep);
+                    # K == 1 never reaches here — the eager branch below IS
+                    # the structurally-off path (no superstep program built)
+                    self._train_superstep(start_round, ckpt_dir, ckpt_every,
+                                          metrics_logger, chaos, guard,
+                                          tracer, ledger)
                 else:
                     self._train_eager(start_round, ckpt_dir, ckpt_every,
                                       metrics_logger, chaos, guard, tracer,
@@ -378,12 +405,26 @@ class FedAvgAPI(Checkpointable):
         every phase serialized against the device. Records commit through
         the same `RoundRecordLog` path as the pipelined loop (one code path
         for history/metrics/ledger), flushed every round."""
-        cfg = self.cfg
         records = RoundRecordLog(tracer, self.history, metrics_logger,
                                  ledger=ledger)
         round_idx = start_round
+        while round_idx < self.cfg.comm_round:
+            round_idx = self._eager_round(round_idx, records, chaos=chaos,
+                                          guard=guard, tracer=tracer,
+                                          ckpt_dir=ckpt_dir,
+                                          ckpt_every=ckpt_every)
+
+    def _eager_round(self, round_idx, records, *, chaos, guard, tracer,
+                     ckpt_dir, ckpt_every) -> int:
+        """One eager round — guard retry attempts included — extracted from
+        the legacy loop body unchanged, so the superstep drive's rollback
+        replay (`_train_superstep`) runs the EXACT per-round program, rng
+        salting, record assembly and flush the eager loop would. Returns
+        round_idx + 1."""
+        cfg = self.cfg
         retries = 0
-        while round_idx < cfg.comm_round:
+        while True:
+            rejected = False
             with tracer.round(round_idx) as rspan:
                 faults = None
                 if chaos is not None:
@@ -414,33 +455,272 @@ class FedAvgAPI(Checkpointable):
                         tracer.event("guard_rollback", round=round_idx,
                                      retry=retries)
                         self._ckpt_load(*snapshot)
-                        continue
-                    if not verdict.ok:
+                        rejected = True  # new attempt, new round span
+                    elif not verdict.ok:
                         log.warning("guard: %s — retries exhausted, accepting "
                                     "the round", verdict.reason)
                         tracer.event("guard_exhausted", round=round_idx)
-                record = {"round": round_idx, "round_time": rspan.elapsed()}
-                block = self._ledger_block(round_idx, *self._last_dispatch)
-                if block is not None:
-                    record["_ledger"] = [block]
-                if faults is not None:
-                    record.update(chaos_summary(faults))
-                    for k in ("participated_count", "quarantined_count"):
-                        if k in train_metrics:
-                            record[k] = train_metrics[k]
-                if guard is not None and retries:
-                    record["guard_retries"] = retries
-                retries = 0
-                if round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1:
-                    with tracer.span("eval", round_idx):
-                        record.update(self.local_test_on_all_clients(round_idx))
-                        record.update(self.test_global(round_idx))
-                records.add(record)
-                records.flush(round_idx)
-                if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
-                    with tracer.span("checkpoint", round_idx):
-                        self.save_checkpoint(ckpt_dir, round_idx + 1)
-            round_idx += 1
+                if not rejected:
+                    record = {"round": round_idx, "round_time": rspan.elapsed()}
+                    block = self._ledger_block(round_idx, *self._last_dispatch)
+                    if block is not None:
+                        record["_ledger"] = [block]
+                    if faults is not None:
+                        record.update(chaos_summary(faults))
+                        for k in ("participated_count", "quarantined_count"):
+                            if k in train_metrics:
+                                record[k] = train_metrics[k]
+                    if guard is not None and retries:
+                        record["guard_retries"] = retries
+                    if round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1:
+                        with tracer.span("eval", round_idx):
+                            record.update(self.local_test_on_all_clients(round_idx))
+                            record.update(self.test_global(round_idx))
+                    records.add(record)
+                    records.flush(round_idx)
+                    if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
+                        with tracer.span("checkpoint", round_idx):
+                            self.save_checkpoint(ckpt_dir, round_idx + 1)
+            if not rejected:
+                return round_idx + 1
+
+    # ------------------------------------------------- superstep drive loop
+    def _resident_train_arrays(self):
+        """Device-resident (x, y, counts) of the WHOLE train store for the
+        superstep's in-graph cohort gather, built once; None when the store
+        is streaming (lazy-decode) or over the byte budget — the drive then
+        falls back to the eager loop."""
+        if self._resident_train is None:
+            from fedml_tpu.data.packed_store import resident_train_arrays
+
+            res = resident_train_arrays(self.dataset.train)
+            self._resident_train = res if res is not None else ()
+        return self._resident_train or None
+
+    def _superstep_fn(self, num_rounds: int, chaos_armed: bool,
+                      in_graph_sampling: bool):
+        """The jitted K-round program for this (k, chaos, sampling) shape,
+        built once per combination — the drive's tail chunk (comm_round %
+        K) and eval-cadence clamps reuse cache slots, they don't retrace
+        per chunk."""
+        key = (num_rounds, chaos_armed, in_graph_sampling)
+        fn = self._superstep_cache.get(key)
+        if fn is None:
+            from fedml_tpu.algorithms.engine import build_superstep_fn
+
+            fn = build_superstep_fn(
+                self.trainer, self.cfg, self.aggregator, num_rounds,
+                client_num_in_total=self.dataset.client_num,
+                collect_stats=self._round_has_stats,
+                chaos_armed=chaos_armed,
+                in_graph_sampling=in_graph_sampling)
+            self._superstep_cache[key] = fn
+        return fn
+
+    def _superstep_k(self, round_idx: int, ckpt_dir, ckpt_every: int) -> int:
+        """Rounds the next superstep may fuse: up to cfg.rounds_per_dispatch,
+        clamped so any eval round (frequency_of_the_test cadence or final
+        round) or checkpoint round lands chunk-FINAL — eval reads the
+        post-round model and checkpoints persist it, so neither can sit in
+        the middle of a fused program. Returns >= 1; a 1 means the next
+        round IS a boundary and runs through the plain eager round."""
+        cfg = self.cfg
+        k_max = min(cfg.rounds_per_dispatch, cfg.comm_round - round_idx)
+        for j in range(k_max):
+            r = round_idx + j
+            if (r % cfg.frequency_of_the_test == 0
+                    or r == cfg.comm_round - 1
+                    or (ckpt_dir and (r + 1) % ckpt_every == 0)):
+                return j + 1
+        return k_max
+
+    def _train_superstep(self, start_round, ckpt_dir, ckpt_every,
+                         metrics_logger, chaos, guard, tracer,
+                         ledger=None) -> None:
+        """Multi-round fused drive loop (`cfg.rounds_per_dispatch` K > 1).
+
+        Each dispatch runs up to K federated rounds as ONE jitted lax.scan
+        (engine.build_superstep_fn): cohorts are gathered in-graph from the
+        device-resident train store, per-round chaos masks are precomputed
+        host-side as [K, C] arrays from the seeded FaultPlan, and the rng
+        stream is fold_in(PRNGKey(seed), round_idx) per scanned round — the
+        EXACT eager stream — so final params, aggregator state (fedopt
+        momenta, codec residuals) and ledger stats rows are bit-identical
+        to K eager rounds (tests/test_superstep.py). Metrics and stats come
+        back [K]-leading and flush through RoundRecordLog as K records with
+        ONE deferred device_get.
+
+        Degradation: a streaming/over-budget train store, or chaos on
+        integer inputs (host fault application is data-dependent there),
+        falls back to `_train_eager` wholesale. A guard rejection inside a
+        chunk rolls the WHOLE chunk back (params + guard state) and replays
+        it through `_eager_round` at K=1 to localize and retry the bad
+        round with the eager loop's exact salted-rng semantics."""
+        cfg = self.cfg
+        resident = self._resident_train_arrays()
+        reason = None
+        if resident is None:
+            reason = ("train store is streaming or over the resident byte "
+                      "budget")
+        elif chaos is not None and not jnp.issubdtype(resident[0].dtype,
+                                                      jnp.floating):
+            reason = ("chaos faults on integer inputs are data-dependent on "
+                      "the host and cannot be replayed in-graph")
+        if reason is not None:
+            log.warning("superstep (rounds_per_dispatch=%d) unavailable: %s "
+                        "— running the eager loop", cfg.rounds_per_dispatch,
+                        reason)
+            self._train_eager(start_round, ckpt_dir, ckpt_every,
+                              metrics_logger, chaos, guard, tracer, ledger)
+            return
+        records = RoundRecordLog(tracer, self.history, metrics_logger,
+                                 ledger=ledger)
+        round_idx = start_round
+        while round_idx < cfg.comm_round:
+            k = self._superstep_k(round_idx, ckpt_dir, ckpt_every)
+            if k == 1:
+                # boundary round (eval/checkpoint/tail): the plain eager
+                # round — same program the superstep's rollback replay uses
+                round_idx = self._eager_round(
+                    round_idx, records, chaos=chaos, guard=guard,
+                    tracer=tracer, ckpt_dir=ckpt_dir, ckpt_every=ckpt_every)
+            else:
+                round_idx = self._superstep_chunk(
+                    round_idx, k, records, resident, chaos=chaos,
+                    guard=guard, tracer=tracer, ckpt_dir=ckpt_dir,
+                    ckpt_every=ckpt_every)
+
+    def _superstep_chunk(self, r0, k, records, resident, *, chaos, guard,
+                         tracer, ckpt_dir, ckpt_every) -> int:
+        """One K-round fused dispatch: host precompute -> one jitted scan ->
+        per-round verdicts -> commit K records (or roll the chunk back and
+        replay it eagerly). Returns the next round index (always r0 + k —
+        a rollback replay still ends the chunk, just eagerly)."""
+        cfg = self.cfg
+        n_total = self.dataset.client_num
+        cohort = min(cfg.client_num_per_round, n_total)
+        in_graph = cfg.fast_sampling and cohort < n_total
+        rollback = False
+        with tracer.round(r0) as rspan:
+            with tracer.span("stage", r0, rounds=k):
+                rids = np.arange(r0, r0 + k, dtype=np.int32)
+                per_round = {"round_idx": rids}
+                sampler = (fast_client_sampling if cfg.fast_sampling
+                           else client_sampling)
+                # host indices always computed (O(K*C) tiny): the ledger
+                # records client ids even when sampling reruns in-graph
+                idx_block = np.stack([
+                    sampler(r, n_total,
+                            cfg.client_num_per_round).astype(np.int32)
+                    for r in range(r0, r0 + k)])
+                if in_graph:
+                    from fedml_tpu.algorithms.sampling import (
+                        feistel_keys_block)
+
+                    per_round["keys"] = feistel_keys_block(r0, k)
+                else:
+                    per_round["idx"] = idx_block
+                faults_list = None
+                if chaos is not None:
+                    faults_list, masks = chaos.events_block(r0, k, cohort)
+                    per_round.update(masks)
+            with tracer.span("h2d", r0):
+                per_round = jax.device_put(per_round)
+            snapshot = guard_state = None
+            if guard is not None:
+                snapshot = (self._ckpt_tree(), self._ckpt_meta())
+                # the guard is stateful (loss window, test doubles' flags);
+                # the eager replay below must re-inspect from the SAME state
+                guard_state = copy.deepcopy(vars(guard))
+            superstep = self._superstep_fn(k, chaos is not None, in_graph)
+            with tracer.span("dispatch", r0, rounds=k):
+                out = superstep(self.global_variables, self.agg_state,
+                                *resident, jax.random.PRNGKey(cfg.seed),
+                                per_round)
+                if self._round_has_stats:
+                    new_gv, new_st, train_metrics, stats = out
+                else:
+                    new_gv, new_st, train_metrics = out
+                    stats = None
+            with tracer.span("device_wait", r0):
+                jax.block_until_ready(new_gv)
+            if guard is not None:
+                with tracer.span("metrics_fetch", r0):
+                    host_metrics = jax.device_get(train_metrics)
+                for j in range(k):
+                    r = r0 + j
+                    # host_metrics is already on the host (one device_get
+                    # above) — numpy scalars feed the guard directly
+                    m_j = {mk: mv[j] for mk, mv in host_metrics.items()}
+                    total = max(m_j.get("total", 1.0), 1.0)
+                    loss = m_j.get("loss_sum", 0.0) / total
+                    # the chunk-final params stand in for round j's (a NaN
+                    # in params/momenta persists through the scan, so
+                    # non-finite state is still caught; the eager replay
+                    # then localizes the exact bad round)
+                    with tracer.span("guard_verdict", r):
+                        verdict = guard.inspect(r, loss, new_gv)
+                    tracer.event("guard_verdict", round=r, ok=verdict.ok,
+                                 reason=verdict.reason)
+                    if not verdict.ok:
+                        rollback = True
+                        log.warning(
+                            "guard: %s at round %d inside a %d-round "
+                            "superstep — chunk rolled back, replaying "
+                            "eagerly to localize", verdict.reason, r, k)
+                        tracer.event("guard_rollback", round=r, retry=0)
+                        self._ckpt_load(*snapshot)
+                        guard.__dict__.update(guard_state)
+                        break
+            if not rollback:
+                self.global_variables = new_gv
+                self.agg_state = new_st
+                elapsed = rspan.elapsed()
+                for j in range(k):
+                    r = r0 + j
+                    record = {"round": r, "round_time": elapsed / k}
+                    if stats is not None:
+                        faults_j = faults_list[j] if faults_list else None
+                        n = idx_block.shape[1]
+                        participated = (
+                            np.asarray(faults_j.participation, bool)[:n]
+                            if faults_j is not None else np.ones(n, bool))
+                        record["_ledger"] = [{
+                            "round": r,
+                            "client_idx": idx_block[j],
+                            # device rows ride the flush's one deferred fetch
+                            "stats": jax.tree.map(lambda a, jj=j: a[jj],
+                                                  stats),
+                            "participated": participated,
+                        }]
+                    if faults_list is not None:
+                        record.update(chaos_summary(faults_list[j]))
+                        for mk in ("participated_count", "quarantined_count"):
+                            if mk in train_metrics:
+                                record[mk] = train_metrics[mk][j]
+                    if j == k - 1 and (
+                            r % cfg.frequency_of_the_test == 0
+                            or r == cfg.comm_round - 1):
+                        with tracer.span("eval", r):
+                            record.update(
+                                self.local_test_on_all_clients(r))
+                            record.update(self.test_global(r))
+                    records.add(record)
+                records.flush(r0 + k - 1)
+                tracer.event("superstep_committed", round=r0, rounds=k,
+                             k=cfg.rounds_per_dispatch)
+                if ckpt_dir and (r0 + k) % ckpt_every == 0:
+                    with tracer.span("checkpoint", r0 + k - 1):
+                        self.save_checkpoint(ckpt_dir, r0 + k)
+        if rollback:
+            # replay the whole chunk through the eager round — exact eager
+            # guard/retry/record semantics, one round span per attempt
+            r = r0
+            while r < r0 + k:
+                r = self._eager_round(r, records, chaos=chaos, guard=guard,
+                                      tracer=tracer, ckpt_dir=ckpt_dir,
+                                      ckpt_every=ckpt_every)
+        return r0 + k
 
     @staticmethod
     def _ledger_block(round_idx, staged, stats):
@@ -561,6 +841,7 @@ class FedAvgAPI(Checkpointable):
         # ledger the moment they occur, so a crash mid-flush cannot lose them
         records = RoundRecordLog(tracer, self.history, metrics_logger,
                                  ledger=ledger)
+        self._last_records = records  # test/ops introspection (max_pending)
         inflight: deque = deque()
 
         round_idx = start_round
@@ -652,7 +933,15 @@ class FedAvgAPI(Checkpointable):
                             record.update(self.local_test_on_all_clients(round_idx))
                             record.update(self.test_global(round_idx))
                     records.add(record)
-                    if guard is not None or is_test or is_ckpt:
+                    # flush at sync points, and ALSO whenever the pending
+                    # backlog exceeds ~2x the pipeline depth: unbounded
+                    # deferral let deep pipelines accumulate host-side
+                    # record debt that competed with the staging thread
+                    # for the one CPU (BENCH_r06 depth-4 regression) —
+                    # the flush here rides rounds that are long done on
+                    # device, so it adds no stall
+                    if (guard is not None or is_test or is_ckpt
+                            or len(records) >= max(4, 2 * cfg.pipeline_depth)):
                         records.flush(round_idx)
                     if is_ckpt:
                         with tracer.span("checkpoint", round_idx):

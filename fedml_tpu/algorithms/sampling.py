@@ -1,0 +1,149 @@
+"""In-graph Feistel cohort sampling — the jnp twin of `fast_client_sampling`.
+
+The superstep drive (engine.build_superstep_fn) fuses K federated rounds
+into one jitted `lax.scan`; the cohort for round t must therefore be
+computed INSIDE the program, from traced inputs only. `fast_client_sampling`
+(algorithms/fedavg.py) is already a pure function of `(round_idx,)` — a
+keyed 4-round Feistel permutation over the enclosing power-of-four domain,
+with a splitmix64-style round function — so it can be replayed in-graph:
+the host precomputes the per-round key schedule (`feistel_keys_block`,
+O(K) tiny work) and the scan walks ids 0..num-1 through the identical
+network on-device.
+
+The only obstacle is arithmetic width: the round function mixes in full
+uint64, but `jnp.uint64` silently degrades to uint32 unless jax's global
+x64 mode is flipped (which would change every other program's dtypes).
+So the 64-bit lane is emulated on (hi, lo) uint32 pairs — schoolbook
+16-bit-limb multiplication for the two constant multiplies, explicit
+carry for the key add, pair-wise shifts for the xor-shifts. Left/right
+Feistel halves are <= 16 bits for any N < 2**31, so they live in single
+uint32 lanes untouched.
+
+Bitwise host-vs-in-graph index equality is pinned by tests/test_sampling.py
+over adversarial domains (N = 1, powers of four, powers of four +- 1, ~1M)
+and under fold_in-derived round indices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+# 0x9E3779B97F4A7C15 / 0xBF58476D1CE4E5B9 as (hi, lo) uint32 pairs — the
+# same constants fast_client_sampling mixes with in uint64
+_GOLDEN = (np.uint32(0x9E3779B9), np.uint32(0x7F4A7C15))
+_MIX = (np.uint32(0xBF58476D), np.uint32(0x1CE4E5B9))
+_U16 = np.uint32(0xFFFF)
+
+
+# ------------------------------------------------------------ host schedule
+
+def feistel_geometry(client_num_in_total: int) -> tuple[int, int]:
+    """(half_bits, mask) of the enclosing power-of-four Feistel domain —
+    the exact geometry fast_client_sampling derives from N."""
+    n = int(client_num_in_total)
+    half_bits = max(1, (max(n - 1, 1).bit_length() + 1) // 2)
+    return half_bits, (1 << half_bits) - 1
+
+
+def feistel_round_keys(round_idx: int) -> np.ndarray:
+    """[4] uint64 — the key schedule fast_client_sampling draws for a round."""
+    return np.random.RandomState(round_idx).randint(
+        0, 2 ** 63, size=4, dtype=np.int64).astype(np.uint64)
+
+
+def split_keys(keys: np.ndarray) -> np.ndarray:
+    """uint64 [..., 4] -> [..., 4, 2] uint32 (hi, lo) pairs, the traced-input
+    form the in-graph permutation consumes."""
+    keys = np.asarray(keys, np.uint64)
+    return np.stack([(keys >> np.uint64(32)).astype(np.uint32),
+                     (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+                    axis=-1)
+
+
+def feistel_keys_block(round_start: int, num_rounds: int) -> np.ndarray:
+    """[K, 4, 2] uint32 key schedule for rounds [round_start, +num_rounds) —
+    the superstep's per-round sampling input."""
+    return split_keys(np.stack([feistel_round_keys(round_start + j)
+                                for j in range(num_rounds)]))
+
+
+# --------------------------------------------------- uint64-on-uint32 lanes
+
+def _mul64(ah, al, bh, bl):
+    """(hi, lo) of (ah*2^32 + al) * (bh*2^32 + bl) mod 2^64. The low-word
+    product al*bl is exact via 16-bit limbs; everything feeding `hi` may
+    wrap mod 2^32, which is the arithmetic uint64 would do anyway."""
+    a0, a1 = al & _U16, al >> 16
+    b0, b1 = bl & _U16, bl >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    t = (p00 >> 16) + (p01 & _U16) + (p10 & _U16)
+    lo = (p00 & _U16) | ((t & _U16) << 16)
+    hi = a1 * b1 + (p01 >> 16) + (p10 >> 16) + (t >> 16)
+    hi = hi + al * bh + ah * bl
+    return hi, lo
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _shr64(ah, al, s: int):
+    if s == 32:
+        return jnp.zeros_like(ah), ah
+    return ah >> s, (al >> s) | (ah << (32 - s))
+
+
+def _feistel_permute(v, keys_hi_lo, half_bits: int, mask_val: int):
+    """jnp replay of fast_client_sampling's permute() over uint32 lanes.
+    `v` uint32 [num]; `keys_hi_lo` [4, 2] uint32; geometry static."""
+    mask = jnp.uint32(mask_val)
+    left = (v >> half_bits) & mask
+    right = v & mask
+    zero = jnp.zeros_like(right)
+    for i in range(4):  # splitmix64-style round function, truncated to a half
+        kh, kl = keys_hi_lo[i, 0], keys_hi_lo[i, 1]
+        mh, ml = _mul64(zero, right, _GOLDEN[0], _GOLDEN[1])
+        mh, ml = _add64(mh, ml, kh, kl)
+        sh, sl = _shr64(mh, ml, 29)
+        mh, ml = mh ^ sh, ml ^ sl
+        mh, ml = _mul64(mh, ml, _MIX[0], _MIX[1])
+        ml = ml ^ mh  # mixed ^= mixed >> 32 only touches the low word
+        left, right = right, left ^ (ml & mask)
+    return (left << half_bits) | right
+
+
+def feistel_cohort_in_graph(keys_hi_lo, client_num_in_total: int,
+                            client_num_per_round: int):
+    """First `num` in-range values of the round's keyed Feistel permutation:
+    the in-graph twin of `fast_client_sampling(round_idx, N, num)` given that
+    round's split key schedule ([4, 2] uint32). Geometry and sizes are
+    static; only the keys are traced, so one compiled program serves every
+    round. Cycle-walking (ids landing >= N re-enter the network) becomes a
+    `lax.while_loop` — the permutation is a bijection, so it terminates.
+
+    Returns int32 ids shaped [min(client_num_per_round, N)]; N == cohort is
+    the caller's static arange fast path and never reaches here.
+    """
+    n = int(client_num_in_total)
+    num = min(int(client_num_per_round), n)
+    half_bits, mask = feistel_geometry(n)
+    if n > np.iinfo(np.int32).max or half_bits > 16:
+        raise ValueError(
+            f"in-graph Feistel sampling returns int32 ids over uint32 "
+            f"half-lanes (<= 16 half bits, N < 2**31); got N={n}")
+    vals = _feistel_permute(jnp.arange(num, dtype=jnp.uint32),
+                            keys_hi_lo, half_bits, mask)
+    vals = lax.while_loop(
+        lambda v: jnp.any(v >= n),
+        lambda v: jnp.where(v >= n,
+                            _feistel_permute(v, keys_hi_lo, half_bits, mask),
+                            v),
+        vals)
+    return vals.astype(jnp.int32)

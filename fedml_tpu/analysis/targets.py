@@ -486,6 +486,29 @@ def enumerate_drive_programs(drive: str) -> dict:
         round_f = build_round_fn(ftrainer, fcfg, agg)
         jax.eval_shape(round_f, fgv, agg_state, fx, fy, fcounts, frng)
         programs["engine.round[cnn,f32,fedavg,fused]"] = 1
+        # superstep twin (a --rounds_per_dispatch K run reaches it): K
+        # rounds scanned in ONE program, chaos-armed + stats-collecting as
+        # the drive builds it (collect_stats always on in FedAvgAPI)
+        from fedml_tpu.algorithms.engine import build_superstep_fn
+
+        scfg = FedConfig(model="lr", batch_size=2, epochs=1,
+                         dtype="float32", client_num_per_round=2,
+                         rounds_per_dispatch=4)
+        super_fn = build_superstep_fn(
+            trainer, scfg, agg, 4, client_num_in_total=2,
+            collect_stats=True, chaos_armed=True)
+
+        def i32(shape=()):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        per_round = {"round_idx": i32((4,)), "idx": i32((4, 2)),
+                     "nan": jax.ShapeDtypeStruct((4, 2), jnp.bool_),
+                     "corrupt": jax.ShapeDtypeStruct((4, 2), jnp.bool_),
+                     "participation": jax.ShapeDtypeStruct((4, 2),
+                                                           jnp.bool_)}
+        jax.eval_shape(super_fn, gv, agg_state, x, y, counts, rng,
+                       per_round)
+        programs["engine.superstep[lr,f32,fedavg,k4]"] = 1
     elif drive == "pipelined":
         # chaos is on for the pipelined config, so every round carries a
         # participation mask — only the masked arm ever compiles

@@ -132,6 +132,19 @@ class FedConfig:
     # client ids). Default off: the default path keeps bit-compat with the
     # seeded rng.choice trajectory of fedavg.client_sampling.
     fast_sampling: bool = False
+    # >1 fuses K federated rounds into ONE jitted lax.scan dispatch
+    # (engine.build_superstep_fn): cohort gather happens in-graph from a
+    # device-resident train store, chaos/participation masks ship as [K, C]
+    # arrays, and K rounds of metrics/stats resolve with a single deferred
+    # device_get. Bit-identical to K eager rounds (tests/test_superstep.py);
+    # eval/checkpoint cadence clamps each chunk so boundary rounds stay
+    # chunk-final, and a guard rejection rolls the chunk back and replays it
+    # eager at K=1 to localize the bad round. 1 = structurally off (the
+    # superstep builder is never invoked; the legacy eager loop runs).
+    # Requires the single-chip vmap engine: mutually exclusive with
+    # pipeline_depth / buffer_size / tensor_shards / silo_threshold /
+    # fused_kernel / backend="shard_map".
+    rounds_per_dispatch: int = 1
     # >0 enables staleness-aware buffered aggregation (FedBuff): client
     # updates are admitted into a device-resident K-row buffer tagged with
     # their birth round and committed into globals only when K updates have

@@ -508,3 +508,36 @@ def materialize(store, budget: int = 4 << 30):
     from fedml_tpu.data import streaming
 
     return streaming.materialize(store)
+
+
+def resident_train_arrays(store, budget: int = 4 << 30):
+    """Device-resident (x, y, counts) of a WHOLE train store — the superstep
+    drive's in-graph gather source (engine.build_superstep_fn pulls cohorts
+    with jnp.take instead of a host select per round).
+
+    In-RAM PackedClients ship as-is; MmapPackedStore goes through the
+    blessed `materialize` read when it fits the byte budget. Streaming
+    stores (whose whole point is never holding the federation) and
+    over-budget stores return None — the caller falls back to the eager
+    per-round staging path. Mirrors the resident-eval seam
+    (fedavg._resident_eval_data): residency is an optimization, never a
+    requirement."""
+    import jax
+
+    from fedml_tpu.data.packing import PackedClients
+
+    if isinstance(store, MmapPackedStore):
+        total = (int(np.prod(store.x.shape, dtype=np.int64))
+                 * store.x.dtype.itemsize)
+        if total > budget:
+            return None
+        store = materialize(store, budget=budget)
+    if not isinstance(store, PackedClients) \
+            or not isinstance(store.x, np.ndarray):
+        return None
+    nbytes = store.x.nbytes + store.y.nbytes + np.asarray(store.counts).nbytes
+    if nbytes > budget:
+        return None
+    telemetry.gauge("store_resident_bytes", store="superstep", bytes=nbytes)
+    return (jax.device_put(store.x), jax.device_put(store.y),
+            jax.device_put(store.counts))

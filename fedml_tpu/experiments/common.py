@@ -97,6 +97,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="1 = run the local epoch as ONE fused pallas "
                              "kernel (femnist-CNN shapes; interpret mode "
                              "on CPU)")
+    # multi-round fused dispatch (engine.build_superstep_fn): K rounds per
+    # jitted lax.scan program — in-graph cohort gather from a device-resident
+    # store, one deferred metrics fetch per chunk. Bit-identical to K eager
+    # rounds; eval/checkpoint cadence clamps K per chunk. 1 = eager loop.
+    parser.add_argument("--rounds_per_dispatch", type=int, default=1,
+                        help="federated rounds fused into one device "
+                             "program (1 = eager; needs pipeline_depth 0)")
     parser.add_argument("--fast_sampling", type=int, default=0,
                         help="1 = O(cohort) Feistel-permutation cohort "
                              "sampler (different seeded trajectory than the "
@@ -229,6 +236,11 @@ def config_from_args(args) -> FedConfig:
     d["fast_sampling"] = bool(d.get("fast_sampling", 0))
     d["shard_step"] = bool(d.get("shard_step", 0))
     d["fused_kernel"] = bool(d.get("fused_kernel", 0))
+    # the superstep subsumes the pipeline (there is no per-round host gap
+    # left to overlap) — a fused CLI run drops the pipeline default rather
+    # than tripping the library's mutual-exclusion check
+    if int(d.get("rounds_per_dispatch", 1)) > 1:
+        d["pipeline_depth"] = 0
     return FedConfig.from_dict(d)
 
 

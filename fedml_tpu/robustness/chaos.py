@@ -89,6 +89,26 @@ class FaultPlan:
                        corrupt=int(corrupt.sum()))
         return events
 
+    def events_block(self, round_start: int, num_rounds: int,
+                     n_clients: int) -> tuple:
+        """Fault decisions for rounds [round_start, round_start+num_rounds)
+        in one call — the superstep drive's [K, C] mask precompute.
+
+        Returns (events, masks): `events` is the per-round FaultEvents list
+        (each drawn through `events()`, so per-round purity, overrides AND
+        the per-round chaos_inject telemetry are identical to K eager
+        calls), `masks` a dict of stacked [K, C] bool arrays keyed
+        "participation" / "nan" / "corrupt" — the traced per-round inputs
+        of engine.build_superstep_fn."""
+        evs = [self.events(round_start + j, n_clients)
+               for j in range(num_rounds)]
+        masks = {
+            "participation": np.stack([e.participation for e in evs]),
+            "nan": np.stack([e.nan_mask for e in evs]),
+            "corrupt": np.stack([e.corrupt_mask for e in evs]),
+        }
+        return evs, masks
+
     def latencies(self, round_idx: int, n_clients: int) -> np.ndarray:
         """Per-client arrival latency (int32 dispatch rounds, 0 = on time)
         for the cohort dispatched at `round_idx` — the seeded straggler

@@ -52,12 +52,16 @@ class RoundRecordLog:
         self.metrics_logger = metrics_logger
         self.ledger = ledger
         self._pending: List[Dict[str, Any]] = []
+        #: high-water mark of pending records — the pipelined loop's bounded
+        #: run-ahead regression pin (tests/test_pipeline.py) reads this
+        self.max_pending = 0
 
     def __len__(self) -> int:
         return len(self._pending)
 
     def add(self, record: Dict[str, Any]) -> None:
         self._pending.append(record)
+        self.max_pending = max(self.max_pending, len(self._pending))
 
     def flush(self, round_idx: Optional[int] = None) -> None:
         """One deferred host sync for every pending record (the pipelined

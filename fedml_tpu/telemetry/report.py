@@ -112,15 +112,20 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         by_name.setdefault(s["name"], []).append(s["dur_s"])
 
     round_durs = by_name.get("round", [])
-    # Drive span total is the honest denominator (includes inter-round
-    # work: final pipeline flush, end-of-drive checkpoint); fall back to
-    # the round-span sum for partial traces.
-    wall_s = sum(by_name.get("drive", [])) or sum(round_durs)
-    rps = len(round_durs) / wall_s if wall_s else 0.0
 
     event_counts: Dict[str, int] = {}
     for e in events:
         event_counts[e["kind"]] = event_counts.get(e["kind"], 0) + 1
+
+    # The superstep drive fuses K rounds under ONE `round` span, so the
+    # span count undercounts rounds K-fold there; round_committed events
+    # (one per committed round, every drive) are the honest count.
+    rounds = max(len(round_durs), event_counts.get("round_committed", 0))
+    # Drive span total is the honest denominator (includes inter-round
+    # work: final pipeline flush, end-of-drive checkpoint); fall back to
+    # the round-span sum for partial traces.
+    wall_s = sum(by_name.get("drive", [])) or sum(round_durs)
+    rps = rounds / wall_s if wall_s else 0.0
 
     # XLA compile accounting from the forwarded jax.monitoring events
     # (utils/cache.py): every compilation fires one
@@ -146,7 +151,12 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "value": round(rps, 4),
         "unit": "rounds/s",
         "vs_baseline": None,
-        "rounds": len(round_durs),
+        "rounds": rounds,
+        # jitted programs entered per round: 1.0 for the eager drive,
+        # ~1/K under --rounds_per_dispatch K — the superstep's headline
+        "dispatches_per_round": (
+            round(len(by_name.get("dispatch", [])) / rounds, 4)
+            if rounds else None),
         "wall_s": round(wall_s, 4),
         "coverage": round(coverage(records), 4),
         "phases": {name: _pcts(durs) for name, durs in sorted(by_name.items())},
@@ -174,10 +184,13 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 # barrier, BENCH_TENANTS_* record multi-tenant jobs/s and job latency under
 # the serving scheduler, BENCH_CODEC_* record wire-bytes-per-round and a
 # codec-on/off committed-updates/s A/B, BENCH_LORA_* record the
-# adapter-only wire shrink and a lora-rank rounds/s A/B. All would poison
-# the rounds/s comparison.
+# adapter-only wire shrink and a lora-rank rounds/s A/B, BENCH_SUPERSTEP_*
+# record a rounds-per-dispatch K-sweep on a shrunk workload, BENCH_FUSED_*
+# record the fused-kernel flagship A/B (cpu_interpret mode off-TPU). All
+# would poison the rounds/s comparison.
 _GATE_SKIP_PREFIXES = ("BENCH_SCALE_", "BENCH_SHARD_", "BENCH_BUFF_",
                        "BENCH_TENANTS_", "BENCH_CODEC_", "BENCH_LORA_",
+                       "BENCH_SUPERSTEP_", "BENCH_FUSED_",
                        # budget pin files are not benches at all; the glob
                        # below can't match them today, but skip by NAME so a
                        # future BENCH_-style rename can't poison the gate

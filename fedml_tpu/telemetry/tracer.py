@@ -59,6 +59,10 @@ EVENT_SCHEMAS: Dict[str, set] = {
     "guard_exhausted": {"round"},
     # unified record path (telemetry/records.py): the history record landed
     "round_committed": {"round"},
+    # superstep drive (algorithms/fedavg.py): one fused K-round dispatch
+    # committed — `round` is the chunk's first round, `rounds` how many it
+    # fused (k_eff after cadence clamping), `k` the configured ceiling
+    "superstep_committed": {"round", "rounds", "k"},
     # checkpointing (utils/checkpoint.py)
     "checkpoint_save": {"step"},
     # self-healing comms (comm/mqtt.py)

@@ -115,6 +115,25 @@ def test_guard_rollback_drops_stale_cohorts(ds8):
     assert _strip_times(piped.history) == _strip_times(eager.history)
 
 
+def test_pipelined_flush_bounds_pending_backlog(ds8):
+    """BENCH_r06 depth-scaling regression pin: without sync points (no
+    guard, rare eval), deferred records must still flush once the backlog
+    reaches ~2x the pipeline depth — unbounded record debt competed with
+    the staging thread for the host CPU at depth 4. The threshold flush
+    rides rounds long done on device, so the trajectory stays bit-identical
+    to the eager loop."""
+    depth = 4
+    eager = _api(ds8, _cfg(12, frequency_of_the_test=100))
+    eager.train()
+    piped = _api(ds8, _cfg(12, pipeline_depth=depth,
+                           frequency_of_the_test=100))
+    piped.train()
+    assert piped._last_records.max_pending <= max(4, 2 * depth)
+    assert len(piped.history) == 12
+    assert _bitwise_equal(piped.global_variables, eager.global_variables)
+    assert _strip_times(piped.history) == _strip_times(eager.history)
+
+
 def test_pipelined_checkpoint_resume_bit_identical(ds8, tmp_path):
     """Interrupt at round 3, resume with a NEW pipelined API: final state
     matches the straight pipelined run AND the straight eager run."""

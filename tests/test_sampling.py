@@ -1,0 +1,93 @@
+"""Feistel sampler seam: the in-graph jnp permutation
+(algorithms/sampling.py, uint64 emulated on uint32 half-lanes) must be
+BITWISE equal to the host `fast_client_sampling` — the superstep's in-graph
+cohorts are only valid because these two never disagree on a single id.
+Domains are adversarial: N=1, powers of four (the Feistel geometry's
+natural sizes), powers of four +- 1 (cycle-walking armed), and ~1M.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import fast_client_sampling
+from fedml_tpu.algorithms.sampling import (
+    feistel_cohort_in_graph,
+    feistel_geometry,
+    feistel_keys_block,
+    feistel_round_keys,
+    split_keys,
+)
+
+
+def _in_graph(round_idx, n, num):
+    keys = split_keys(feistel_round_keys(round_idx))
+    return np.asarray(feistel_cohort_in_graph(jnp.asarray(keys), n, num))
+
+
+@pytest.mark.parametrize("n", [1, 3, 4, 5, 16, 17, 63, 64, 65, 1024])
+@pytest.mark.parametrize("round_idx", [0, 1, 7, 12345])
+def test_in_graph_matches_host_bitwise(n, round_idx):
+    num = min(max(n // 2, 1), n)
+    host = fast_client_sampling(round_idx, n, num)
+    if n == num:  # host arange fast path — the superstep drive mirrors it
+        assert np.array_equal(host, np.arange(n))
+        return
+    got = _in_graph(round_idx, n, num)
+    np.testing.assert_array_equal(got, host)
+    assert got.size == len(set(got.tolist()))  # without replacement
+    assert (got >= 0).all() and (got < n).all()
+
+
+@pytest.mark.parametrize("n", [2 ** 20 - 1, 1_000_003])
+def test_in_graph_matches_host_at_scale(n):
+    host = fast_client_sampling(11, n, 64)
+    np.testing.assert_array_equal(_in_graph(11, n, 64), host)
+
+
+def test_fold_in_derived_round_seeds():
+    """The superstep's key schedule is per-ROUND (RandomState(round_idx)),
+    independent of how the drive derives its data rng — sweep a block of
+    consecutive rounds as feistel_keys_block stages them."""
+    n, num, r0, k = 1024, 32, 40, 8
+    keys = jnp.asarray(feistel_keys_block(r0, k))
+    for j in range(k):
+        host = fast_client_sampling(r0 + j, n, num)
+        got = np.asarray(feistel_cohort_in_graph(keys[j], n, num))
+        np.testing.assert_array_equal(got, host)
+
+
+def test_keys_block_shape_and_split_roundtrip():
+    blk = feistel_keys_block(3, 5)
+    assert blk.shape == (5, 4, 2) and blk.dtype == np.uint32
+    raw = feistel_round_keys(3)
+    hi_lo = split_keys(raw)
+    back = (hi_lo[:, 0].astype(np.uint64) << np.uint64(32)) | \
+        hi_lo[:, 1].astype(np.uint64)
+    np.testing.assert_array_equal(back, raw)
+
+
+def test_geometry_matches_host_derivation():
+    for n in (1, 2, 4, 5, 64, 65, 1 << 20):
+        half_bits, mask = feistel_geometry(n)
+        assert half_bits == max(1, (max(n - 1, 1).bit_length() + 1) // 2)
+        assert mask == (1 << half_bits) - 1
+
+
+def test_rejects_domains_past_uint32_half_lanes():
+    with pytest.raises(ValueError, match="2\\*\\*31"):
+        feistel_cohort_in_graph(jnp.zeros((4, 2), jnp.uint32), 2 ** 31 + 1, 8)
+
+
+def test_jit_stable_under_vmapped_keys():
+    """One compiled program serves every round: keys are the only traced
+    input, so a jit over the key schedule must not retrace per round."""
+    n, num = 257, 16
+    fn = jax.jit(lambda kk: feistel_cohort_in_graph(kk, n, num))
+    for r in (0, 5, 99):
+        host = fast_client_sampling(r, n, num)
+        got = np.asarray(fn(jnp.asarray(split_keys(feistel_round_keys(r)))))
+        np.testing.assert_array_equal(got, host)
+    assert fn._cache_size() == 1

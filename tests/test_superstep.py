@@ -1,0 +1,264 @@
+"""Multi-round fused dispatch (the superstep, --rounds_per_dispatch K):
+K federated rounds per jitted program must be BIT-identical to K eager
+rounds — params, aggregator state (fedopt momenta, codec residuals), ledger
+stats rows and history — under chaos masks and compressed transport, with
+K-fold fewer `dispatch` spans, structurally off at K=1, and degrading to
+the eager loop (guard rollback replay, streaming stores) without losing the
+trajectory.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu import telemetry
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.robustness.guard import GuardVerdict
+from fedml_tpu.telemetry.client_ledger import COLUMNS, open_or_create
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _cfg(comm_round=9, **kw):
+    kw.setdefault("client_num_per_round", 8)
+    # frequency_of_the_test=1 would make every round an eval boundary and
+    # clamp every chunk to K=1 — push eval to the final round only
+    kw.setdefault("frequency_of_the_test", 100)
+    return FedConfig(dataset="mnist", model="lr", comm_round=comm_round,
+                     batch_size=8, lr=0.05, client_num_in_total=8,
+                     seed=0, **kw)
+
+
+def _api(ds, cfg, aggregator_name="fedavg"):
+    trainer = ClassificationTrainer(create_model("lr", output_dim=ds.class_num))
+    return FedAvgAPI(ds, cfg, trainer, aggregator_name=aggregator_name)
+
+
+def _bitwise_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+def _strip_times(history):
+    return [{k: v for k, v in r.items() if k != "round_time"}
+            for r in history]
+
+
+def _span_count(trace_path, name):
+    n = 0
+    with open(trace_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "span" and rec.get("name") == name:
+                n += 1
+    return n
+
+
+# ------------------------------------------------------------- bit identity
+
+# the acceptance matrix is (fedavg, fedopt) x (plain, chaos); the diagonal
+# runs in tier-1, the off-diagonal pair rides the slow lane — same code
+# paths, kept for completeness
+@pytest.mark.parametrize("agg_name,cfg_extra,chaos_on", [
+    ("fedavg", {}, False),
+    ("fedopt", {"server_optimizer": "adam", "server_lr": 0.01}, True),
+    pytest.param("fedavg", {}, True, marks=pytest.mark.slow),
+    pytest.param("fedopt", {"server_optimizer": "adam", "server_lr": 0.01},
+                 False, marks=pytest.mark.slow),
+])
+def test_superstep_bit_identical_to_eager(ds8, agg_name, cfg_extra, chaos_on):
+    """K=8 fused == 8 eager rounds bitwise: params, momenta, history."""
+    plan = lambda: (FaultPlan(seed=7, drop_rate=0.3, nan_rate=0.4)
+                    if chaos_on else None)
+    eager = _api(ds8, _cfg(9, **cfg_extra), agg_name)
+    eager.train(chaos=plan())
+    fused = _api(ds8, _cfg(9, rounds_per_dispatch=8, **cfg_extra), agg_name)
+    fused.train(chaos=plan())
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _bitwise_equal(fused.agg_state, eager.agg_state)
+    assert _strip_times(fused.history) == _strip_times(eager.history)
+
+
+def test_superstep_codec_residual_rides_carry(ds8):
+    """--update_codec int8: the codec residual is part of agg_state and must
+    thread through the scan carry bit-exactly (momenta-style)."""
+    eager = _api(ds8, _cfg(9, update_codec="int8"))
+    eager.train(chaos=FaultPlan(seed=7, drop_rate=0.3, nan_rate=0.4))
+    fused = _api(ds8, _cfg(9, update_codec="int8", rounds_per_dispatch=8))
+    fused.train(chaos=FaultPlan(seed=7, drop_rate=0.3, nan_rate=0.4))
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _bitwise_equal(fused.agg_state, eager.agg_state)
+    assert _strip_times(fused.history) == _strip_times(eager.history)
+
+
+@pytest.mark.slow
+def test_superstep_lora_composes(ds8):
+    """--lora_rank: adapters-only aggregation + per-round base re-attach
+    inside the scan."""
+    eager = _api(ds8, _cfg(9, lora_rank=4))
+    eager.train()
+    fused = _api(ds8, _cfg(9, lora_rank=4, rounds_per_dispatch=4))
+    fused.train()
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _strip_times(fused.history) == _strip_times(eager.history)
+
+
+def test_superstep_in_graph_feistel_sampling(ds8):
+    """--fast_sampling with a sub-total cohort: the in-graph Feistel twin
+    must reproduce the host sampler's cohorts bitwise end to end."""
+    eager = _api(ds8, _cfg(9, client_num_per_round=4, fast_sampling=True))
+    eager.train(chaos=FaultPlan(seed=3, drop_rate=0.25, corrupt_rate=0.25))
+    fused = _api(ds8, _cfg(9, client_num_per_round=4, fast_sampling=True,
+                           rounds_per_dispatch=8))
+    fused.train(chaos=FaultPlan(seed=3, drop_rate=0.25, corrupt_rate=0.25))
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _strip_times(fused.history) == _strip_times(eager.history)
+
+
+@pytest.mark.slow
+def test_superstep_ledger_rows_identical(ds8, tmp_path):
+    """Per-cohort ledger stats rows ride the [K]-stacked scan outputs and
+    scatter-write identically to K eager flushes."""
+    def run(k):
+        ledger = open_or_create(str(tmp_path / f"ledger_k{k}"), 8)
+        api = _api(ds8, _cfg(9, rounds_per_dispatch=k))
+        api.train(chaos=FaultPlan(seed=7, drop_rate=0.3, nan_rate=0.4),
+                  ledger=ledger)
+        ledger.flush()
+        return ledger
+    l1, l8 = run(1), run(8)
+    for name, _, _ in COLUMNS:
+        np.testing.assert_array_equal(l1.column(name), l8.column(name),
+                                      err_msg=name)
+
+
+# ------------------------------------------------- dispatch-count contract
+
+def test_superstep_dispatch_count_drops_k_fold(ds8, tmp_path):
+    """The headline: `dispatch` span count per round <= 1/K * eager + O(1),
+    proven from TRACE.jsonl."""
+    def run(k, name):
+        trace = str(tmp_path / f"{name}.jsonl")
+        tracer = telemetry.Tracer(jsonl_path=trace)
+        api = _api(ds8, _cfg(8, rounds_per_dispatch=k))
+        api.train(tracer=tracer)
+        tracer.close()
+        return _span_count(trace, "dispatch")
+    eager_n = run(1, "eager")
+    fused_n = run(4, "fused")
+    assert eager_n == 8
+    # 8 rounds at K=4: round 0 is the r%freq==0 eval boundary (eager),
+    # rounds 1-4 one chunk, 5-7 a clamped chunk ending at the final-eval
+    # round -> 3 dispatches, <= 8/4 + O(1)
+    assert fused_n <= eager_n // 4 + 2
+    # superstep_committed events cover the fused chunks
+    events = []
+    with open(str(tmp_path / "fused.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "event" and rec.get("kind") == "superstep_committed":
+                events.append(rec)
+    assert sum(e["rounds"] for e in events) == 7  # all but the eager round 0
+    assert all(e["k"] == 4 for e in events)
+
+
+def test_superstep_k1_structurally_off(ds8, monkeypatch):
+    """rounds_per_dispatch=1 must never build a superstep program — the
+    eager branch IS the K=1 path."""
+    import fedml_tpu.algorithms.engine as engine
+
+    def boom(*a, **kw):
+        raise AssertionError("superstep program built at K=1")
+
+    monkeypatch.setattr(engine, "build_superstep_fn", boom)
+    api = _api(ds8, _cfg(3, rounds_per_dispatch=1))
+    api.train()
+    assert len(api.history) == 3
+    assert api._superstep_cache == {}
+
+
+def test_superstep_rejects_incompatible_modes(ds8):
+    with pytest.raises(ValueError, match="superstep"):
+        _api(ds8, _cfg(3, rounds_per_dispatch=4, pipeline_depth=2))
+    with pytest.raises(ValueError, match="superstep"):
+        _api(ds8, _cfg(3, rounds_per_dispatch=4, buffer_size=2))
+
+
+# ------------------------------------------------------- graceful degrade
+
+class _RejectOnce:
+    """Deterministic guard: rejects exactly one round once, accepts after."""
+
+    max_retries = 2
+
+    def __init__(self, bad_round=3):
+        self.bad_round = bad_round
+        self.fired = False
+
+    def inspect(self, round_idx, loss, global_variables=None):
+        if round_idx == self.bad_round and not self.fired:
+            self.fired = True
+            return GuardVerdict(False, "forced test rejection")
+        return GuardVerdict(True, "")
+
+
+def test_superstep_guard_rollback_replays_chunk_eagerly(ds8):
+    """A rejection inside a chunk rolls the WHOLE chunk back (params AND
+    guard state) and replays it at K=1 — localizing the bad round with the
+    eager loop's exact salted-rng retry, so the trajectory matches pure
+    eager under the same guard."""
+    eager = _api(ds8, _cfg(9))
+    eager.train(guard=_RejectOnce(bad_round=3))
+    fused = _api(ds8, _cfg(9, rounds_per_dispatch=8))
+    fused.train(guard=_RejectOnce(bad_round=3))
+    assert fused.history[3].get("guard_retries") == 1
+    assert [r["round"] for r in fused.history] == list(range(9))
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _bitwise_equal(fused.agg_state, eager.agg_state)
+    assert _strip_times(fused.history) == _strip_times(eager.history)
+
+
+@pytest.mark.slow
+def test_superstep_checkpoint_cadence_clamps_k(ds8, tmp_path):
+    """ckpt_every=3 with K=8: chunks clamp so checkpoint rounds land
+    chunk-final; an interrupt + resume matches the straight eager run."""
+    straight = _api(ds8, _cfg(9))
+    straight.train()
+
+    d = str(tmp_path / "ckpt_superstep")
+    first = _api(ds8, _cfg(6, rounds_per_dispatch=8))
+    first.train(ckpt_dir=d, ckpt_every=3)
+    resumed = _api(ds8, _cfg(9, rounds_per_dispatch=8))
+    hist = resumed.train(ckpt_dir=d, ckpt_every=3)
+
+    assert _bitwise_equal(resumed.global_variables, straight.global_variables)
+    assert _bitwise_equal(resumed.agg_state, straight.agg_state)
+    assert len(hist) == 9
+
+
+@pytest.mark.slow
+def test_superstep_streaming_store_falls_back_eager(ds8, monkeypatch):
+    """No device-resident train store -> the drive degrades to the eager
+    loop wholesale, same trajectory."""
+    eager = _api(ds8, _cfg(5))
+    eager.train()
+    fused = _api(ds8, _cfg(5, rounds_per_dispatch=4))
+    monkeypatch.setattr(fused, "_resident_train_arrays", lambda: None)
+    fused.train()
+    assert fused._superstep_cache == {}
+    assert _bitwise_equal(fused.global_variables, eager.global_variables)
+    assert _strip_times(fused.history) == _strip_times(eager.history)

@@ -100,6 +100,7 @@ _SAMPLE_EVENTS = {
     "guard_rollback": dict(round=1, retry=1),
     "guard_exhausted": dict(round=2),
     "round_committed": dict(round=0, participated_count=6.0),
+    "superstep_committed": dict(round=4, rounds=4, k=4),
     "checkpoint_save": dict(step=5),
     "mqtt_reconnect": dict(client_id="c0", ok=True, attempts=2),
     "compile_cache": dict(name="persistent_cache_hit"),
@@ -421,6 +422,25 @@ def test_newest_bench_skips_shard_schema_by_name(tmp_path):
     with open(tmp_path / "BENCH_SHARD_r99.json", "w") as f:
         json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
     assert newest_bench(str(tmp_path)) is None
+
+
+def test_newest_bench_skips_superstep_and_fused_schemas_by_name(tmp_path):
+    """BENCH_SUPERSTEP_* is a K-sweep on a shrunk dispatch-bound workload
+    and BENCH_FUSED_* is the fused-kernel flagship A/B (cpu_interpret mode
+    off-TPU) — neither is a drive-throughput baseline. Both are skipped by
+    NAME even when their arms carry rounds_per_sec numbers; the gate falls
+    through to the real drive bench."""
+    with open(tmp_path / "BENCH_SUPERSTEP_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0,
+                              "arms": {"0": {"rounds_per_sec": 9999.0}}}}, f)
+    with open(tmp_path / "BENCH_FUSED_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
+    assert newest_bench(str(tmp_path)) is None
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 12.5}}, f)
+    path, parsed = newest_bench(str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r02.json"
+    assert parsed["rounds_per_sec"] == 12.5
 
 
 def test_newest_bench_skips_buffered_schema_by_name(tmp_path):
