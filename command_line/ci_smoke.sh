@@ -165,6 +165,38 @@ assert any(pin in f.message and "not budgeted" in f.message
 print("OK compile budget trips when the superstep pin is removed")
 EOF
 
+echo "== graft-lint matrix layer (feature matrix vs core/spec.py tables)"
+# enumerates the full legal feature matrix from the declarative spec,
+# abstractly traces a pairwise cover through the real round builders,
+# proves every illegal axis combination raises at config-validation time
+# with the table's reason, cross-checks COMPILE/COMMS budget coverage
+# against the spec's program surface, and runs the axis-drift AST rule
+# over the round assemblers; MATRIX.json is the committed machine report
+python -m fedml_tpu.analysis --matrix --json MATRIX.json
+
+echo "== matrix coverage self-test: an unpinned reachable program must trip"
+# remove the sharded topk64 codec-twin pin (the program this layer first
+# proved reachable) from an in-memory copy of COMPILE_BUDGET.json — the
+# spec<->budget diff must produce a matrix-coverage finding with a
+# readable reachable-but-not-gated message, proving the coverage gate is
+# a live diff and not dead JSON
+python - <<'EOF'
+import json
+from fedml_tpu.analysis.matrix_engine import check_budget_coverage
+pin = "sharded.round[lr,f32,fedavg,8,topk64]"
+budgets = json.load(open("COMPILE_BUDGET.json"))
+assert pin in budgets["sharded"]["programs"], "topk64 pin missing from repo"
+assert not check_budget_coverage(".", compile_budgets=budgets), \
+    "committed budgets out of coverage"
+del budgets["sharded"]["programs"][pin]
+findings = check_budget_coverage(".", compile_budgets=budgets)
+hit = [f for f in findings
+       if f.rule == "matrix-coverage" and pin in f.message]
+assert hit and "not budget-gated" in hit[0].message, findings
+print("OK matrix coverage trips when the sharded topk64 pin is removed:")
+print("  ", hit[0].message)
+EOF
+
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"
 python -m fedml_tpu.experiments.main_base --client_num 4 --comm_round 2
 

@@ -508,32 +508,19 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
         # kernel (ops/fused_sgd.py). The kernel IS the model+optimizer
         # program, so every knob it cannot honor is rejected loudly here
         # instead of silently diverging from the engine trajectory.
-        if param_sharding is not None or cfg.tensor_shards > 0:
+        # config-level exclusions + value constraints live in the ONE
+        # table (core/spec.py, graft-matrix); only the checks on runtime
+        # ARGUMENTS (param_sharding/codec objects, the trainer's module)
+        # stay local — the config cannot see those
+        from fedml_tpu.core.spec import validate_config
+        validate_config(cfg)
+        if param_sharding is not None:
             raise ValueError(
                 "--fused_kernel is mutually exclusive with --tensor_shards "
                 "(the kernel owns the whole client step)")
-        if codec is not None or cfg.update_codec != "none":
+        if codec is not None:
             raise ValueError(
                 "--fused_kernel is mutually exclusive with --update_codec")
-        if cfg.buffer_size > 0:
-            raise ValueError(
-                "--fused_kernel is mutually exclusive with --buffer_size "
-                "(buffered admission consumes per-client LocalResults)")
-        if getattr(cfg, "lora_rank", 0) > 0:
-            raise ValueError(
-                "--fused_kernel is mutually exclusive with --lora_rank "
-                "(the kernel trains the raw CNN param layout)")
-        if (cfg.client_optimizer != "sgd" or cfg.momentum or cfg.wd
-                or cfg.fedprox_mu):
-            raise ValueError(
-                "the fused kernel implements plain SGD with global-norm "
-                "clip — sgd, momentum 0, wd 0, fedprox_mu 0 required")
-        if cfg.epochs != 1:
-            raise ValueError("the fused kernel runs exactly one local epoch")
-        if cfg.grad_clip is None:
-            raise ValueError(
-                "the fused kernel clips unconditionally (reference "
-                "semantics) — grad_clip must be set")
         if type(trainer.module).__name__ != "CNN_DropOut":
             raise ValueError(
                 "--fused_kernel supports the femnist CNN_DropOut model only")

@@ -123,45 +123,14 @@ class FedAvgAPI(Checkpointable):
         # every code path EXACTLY as before — codec-off rounds are
         # bit-identical by construction, not by tolerance
         self.codec = make_codec(config.update_codec, config)
-        if self.codec is not None and config.silo_threshold > 0:
-            raise ValueError(
-                "update_codec has no seam in the silo-grouped lowering "
-                "(silos merge clients before any update crosses a wire) — "
-                "drop one of update_codec / silo_threshold")
-        if config.buffer_size > 0 and (
-                config.backend != "vmap" or config.tensor_shards > 0
-                or config.silo_threshold > 0):
-            raise ValueError(
-                "buffer_size (staleness-aware buffered aggregation) drives "
-                "the single-controller vmap engine; the sharded admit/commit "
-                "twin (parallel.sharded.build_sharded_buffer_fns) is a "
-                "program-level building block — combine buffer_size with "
-                "neither backend='shard_map', tensor_shards, nor "
-                "silo_threshold")
-        if config.rounds_per_dispatch > 1 and (
-                config.pipeline_depth > 0 or config.buffer_size > 0
-                or config.backend != "vmap" or config.tensor_shards > 0
-                or config.silo_threshold > 0 or config.fused_kernel):
-            raise ValueError(
-                "rounds_per_dispatch (the multi-round superstep) fuses K "
-                "rounds into ONE program on the single-chip vmap engine — "
-                "there is no per-round host gap left for the pipeline or "
-                "buffer to exploit, and the sharded/silo/fused lowerings "
-                "have no superstep twin; combine it with none of "
-                "pipeline_depth / buffer_size / backend='shard_map' / "
-                "tensor_shards / silo_threshold / fused_kernel")
-        if config.silo_threshold > 0 and config.backend == "shard_map":
-            raise ValueError(
-                "silo_threshold (the single-chip silo-grouped conv path) "
-                "and backend='shard_map' are mutually exclusive — the "
-                "grouped lowering merges silos on ONE chip; drop one of the "
-                "two settings")
+        # graft-matrix: the per-drive mutual-exclusion checks that used to
+        # live here as a wall of if/raise now live in ONE table
+        # (core/spec.py EXCLUSIONS) — validate() raises the table's reason
+        # for the first violated pair, same messages as before. The
+        # aggregator rule is not a config field, so overlay its level for
+        # the n-ary constraints (tensor x codec x robust/fednova).
+        config.validate(aggregator=aggregator_name)
         if config.tensor_shards > 0:
-            if config.silo_threshold > 0 or config.backend == "shard_map":
-                raise ValueError(
-                    "tensor_shards already places rounds on its own 2D "
-                    "('clients', 'tensor') mesh — combine it with neither "
-                    "silo_threshold nor backend='shard_map'")
             from fedml_tpu.parallel import TensorSharding, make_tensor_mesh
 
             self.mesh = make_tensor_mesh(config.tensor_shards)

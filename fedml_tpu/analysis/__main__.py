@@ -22,6 +22,15 @@ the flag surface:
   drives; `--update-budgets` rewrites the pins (and, unless --fast,
   re-measures each runtime config's max_compiles ceiling with a traced
   10-round subprocess drive — minutes). `--json` writes COMPILE.json.
+- `--matrix`: the matrix layer — enumerate the legal feature matrix
+  from the declarative spec (core/spec.py), abstractly trace a pairwise
+  cover of it through the real round builders, prove every illegal axis
+  combination raises at config-validation time with the table's reason,
+  cross-check COMPILE/COMMS budget coverage against the spec's program
+  surface, and run the axis-drift AST rule over the round assemblers.
+  `--fast` traces one cover point per round family; `--update-budgets`
+  rewrites COMPILE_BUDGET.json from the spec-derived enumeration
+  (static counts only). `--json` writes MATRIX.json.
 
 Run from anywhere — the repo root is derived from the package location.
 """
@@ -54,6 +63,11 @@ def main(argv=None) -> int:
                    help="run the compile layer instead: compile-discipline "
                         "AST rules + drive-config program counts gated "
                         "against COMPILE_BUDGET.json")
+    p.add_argument("--matrix", action="store_true",
+                   help="run the matrix layer instead: enumerate the legal "
+                        "feature matrix from core/spec.py, trace a pairwise "
+                        "cover, prove every illegal combination raises, "
+                        "cross-check budget coverage, lint axis drift")
     p.add_argument("--target", action="append", metavar="SUBSTR",
                    help="(--comms) only lower programs whose name contains "
                         "SUBSTR; (--compile) only these drive configs; "
@@ -67,6 +81,28 @@ def main(argv=None) -> int:
 
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.matrix:
+        # same mesh contract as --comms/--compile: tracing the sharded /
+        # tensor / hierarchical families needs 8 virtual devices, set
+        # before jax initializes its backend
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+        from fedml_tpu.analysis.matrix_engine import (format_matrix_table,
+                                                      run_matrix)
+
+        report, matrix = run_matrix(
+            repo_root, fast=args.fast, update_budgets=args.update_budgets)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(matrix, f, indent=2)
+                f.write("\n")
+        print(format_matrix_table(matrix))
+        print(report.summary())
+        return 0 if report.ok else 1
 
     if args.compile_layer:
         # same mesh contract as --comms: the tensor/sharded/hierarchical

@@ -68,6 +68,16 @@ RULES = {
                           "desync the codec from FedConfig and its budget "
                           "program twins)",
     "bare-suppression": "graft-lint: disable comment without a '-- reason'",
+    # Matrix-layer rules (matrix_engine / --matrix): the declarative
+    # RoundProgramSpec (core/spec.py) vs the repo.
+    "matrix-coverage": "feature-matrix drift: a legal axis combination "
+                       "fails to build, an illegal one passes config "
+                       "validation, or a spec-reachable program is missing "
+                       "from (or stale in) COMPILE/COMMS budget pins",
+    "axis-drift": "round assembler signature diverges from its "
+                  "spec.ASSEMBLERS declaration — a feature-axis kwarg "
+                  "siblings thread through is missing, or a new one is "
+                  "undeclared",
     "unschema-event": "tracer.event()/telemetry.emit() with a literal kind "
                       "that is not in EVENT_SCHEMAS (the call raises "
                       "ValueError the first time it fires at runtime — "

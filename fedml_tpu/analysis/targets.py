@@ -355,10 +355,11 @@ def check_tensor_rule_coverage(rule_tables=None,
 
 # ------------------------------------------------------------------ drives
 # The registered drive configs whose XLA program sets COMPILE_BUDGET.json
-# pins (compile_engine). Each enumerator abstractly traces every jit entry
-# point the drive loop reaches and returns {program name: #signatures} —
-# tracing (not just listing) makes the enumeration crash the moment a
-# signature arm drifts from the real builders.
+# pins (compile_engine). The per-drive program LISTS live in the
+# declarative spec (core/spec.py DRIVE_SPECS, graft-matrix) — codec twins
+# are expanded from the codec axis there, not hand-listed here. This
+# module's job is to TRACE every declared point through the real builders,
+# so the enumeration still crashes the moment a signature arm drifts.
 DRIVE_CONFIGS = ("eager", "pipelined", "buffered", "tensor", "sharded",
                  "hierarchical", "silo", "serving", "finetune")
 
@@ -387,13 +388,17 @@ def _drive_eval_programs(trainer, shape, in_dtype, gv, rng):
             "engine.federation_eval[lr,f32]": 2}
 
 
-def _drive_codecs(cfg, codec_k: int):
-    """The codec-on program variants every codec-armed drive pins: the int8
-    quantizer and the top-k sparsifier at the drive's COMMS-budget k."""
+def _point_codec(point, cfg):
+    """The codec a spec ProgramPoint's name tag declares (int8 at the
+    config's bit width, topk at the point's pinned k), or None."""
     from fedml_tpu.codecs import make_codec
 
-    return (make_codec("int8", cfg),
-            make_codec("topk", {"codec_k": codec_k}))
+    level = point.opt("codec")
+    if level is None:
+        return None
+    if level == "int8":
+        return make_codec("int8", cfg)
+    return make_codec("topk", {"codec_k": point.opt("codec_k")})
 
 
 def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
@@ -427,9 +432,13 @@ def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
                    i32(), i32())
     programs["buffered.admit[lr,f32]"] = 1
     for codec in codecs:
+        # the codec delta base mirrors the WIRE tree — adapters-only under
+        # LoRA, same strip the drive applies (algorithms/buffered.py)
+        from fedml_tpu.models.lora import strip_lora_base
+
         jax.eval_shape(build_buffer_admit(codec=codec), buf,
                        result.variables, result.num_steps, result.metrics,
-                       counts, i32(), i32(), gv)
+                       counts, i32(), i32(), strip_lora_base(gv))
         programs[f"buffered.admit[lr,f32,{codec.name}]"] = 1
     jax.eval_shape(build_buffer_commit(agg, make_staleness_discount(0.5)),
                    gv, agg_state, buf, i32(), rng)
@@ -437,198 +446,250 @@ def _trace_buffered_programs(trainer, cfg, agg, gv, agg_state, x, y, counts,
     return programs
 
 
-def enumerate_drive_programs(drive: str) -> dict:
-    """{program name: distinct signature count} for one registered drive
-    config — the static half of the compile budget. All programs trace on
-    the lr/f32/fedavg example (signature COUNT does not depend on the
-    model), except silo which needs a conv model to group."""
-    from fedml_tpu.algorithms.aggregators import make_aggregator
+def _trace_engine_round(point, ctx) -> None:
+    """Trace one declared engine.round point: the base vmap round, or its
+    masked / federated-LoRA / fused-kernel / codec-wrapped twin, per the
+    point's spec opts."""
     from fedml_tpu.algorithms.engine import build_round_fn
 
-    if drive not in DRIVE_CONFIGS:
-        raise ValueError(f"unknown drive config {drive!r}; "
-                         f"known: {sorted(DRIVE_CONFIGS)}")
-    trainer, shape, in_dtype = _tiny_trainer("lr", "float32")
-    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
-    agg = make_aggregator("fedavg", cfg)
-    gv, x, y, counts, rng = _abstract_round_args(trainer, shape, in_dtype)
-    agg_state = jax.eval_shape(agg.init_state, gv)
-    part = jax.ShapeDtypeStruct((2,), jnp.bool_)
-    programs = {}
-
-    if drive == "eager":
-        round_fn = build_round_fn(trainer, cfg, agg)
-        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
-        programs["engine.round[lr,f32,fedavg]"] = 1
-    elif drive == "finetune":
-        # the flag-gated fine-tuning twins of the eager drive: a plain
-        # eager run never compiles these, so they get their own (no-CLI,
-        # no-max_compiles) config instead of inflating the eager ceiling.
-        # federated-LoRA round (a --lora_rank run reaches it): adapters
-        # under "params", frozen base riding as the lora_base collection —
-        # a distinct jit signature the budget pins as its own program
-        from fedml_tpu.models.lora import LoRATrainer
-
-        ltrainer = LoRATrainer(trainer, rank=8)
-        lgv, lx, ly, lcounts, lrng = _abstract_round_args(
-            ltrainer, shape, in_dtype)
-        round_l = build_round_fn(ltrainer, cfg, agg)
-        jax.eval_shape(round_l, lgv, jax.eval_shape(agg.init_state, lgv),
-                       lx, ly, lcounts, lrng)
-        programs["engine.round[lr,f32,fedavg,lora8]"] = 1
+    trainer, cfg, agg = ctx["trainer"], ctx["cfg"], ctx["agg"]
+    gv, x, y = ctx["gv"], ctx["x"], ctx["y"]
+    counts, rng, agg_state = ctx["counts"], ctx["rng"], ctx["agg_state"]
+    if point.opt("fused"):
         # fused-kernel twin (a --fused_kernel run reaches it): the
         # CNN_DropOut epoch kernel replacing the vmap round wholesale
-        ftrainer, fshape, f_dtype = _tiny_trainer("cnn", "float32")
-        fcfg = FedConfig(model="cnn", batch_size=2, epochs=1,
+        model = point.opt("model")
+        ftrainer, fshape, f_dtype = _tiny_trainer(model, "float32")
+        fcfg = FedConfig(model=model, batch_size=2, epochs=1,
                          dtype="float32", fused_kernel=True, grad_clip=10.0)
         fgv, fx, fy, fcounts, frng = _abstract_round_args(
             ftrainer, fshape, f_dtype)
         round_f = build_round_fn(ftrainer, fcfg, agg)
         jax.eval_shape(round_f, fgv, agg_state, fx, fy, fcounts, frng)
-        programs["engine.round[cnn,f32,fedavg,fused]"] = 1
-        # superstep twin (a --rounds_per_dispatch K run reaches it): K
-        # rounds scanned in ONE program, chaos-armed + stats-collecting as
-        # the drive builds it (collect_stats always on in FedAvgAPI)
-        from fedml_tpu.algorithms.engine import build_superstep_fn
+        return
+    if point.opt("lora_rank"):
+        # federated-LoRA round (a --lora_rank run reaches it): adapters
+        # under "params", frozen base riding as the lora_base collection —
+        # a distinct jit signature the budget pins as its own program
+        from fedml_tpu.models.lora import LoRATrainer
 
-        scfg = FedConfig(model="lr", batch_size=2, epochs=1,
-                         dtype="float32", client_num_per_round=2,
-                         rounds_per_dispatch=4)
-        super_fn = build_superstep_fn(
-            trainer, scfg, agg, 4, client_num_in_total=2,
-            collect_stats=True, chaos_armed=True)
-
-        def i32(shape=()):
-            return jax.ShapeDtypeStruct(shape, jnp.int32)
-
-        per_round = {"round_idx": i32((4,)), "idx": i32((4, 2)),
-                     "nan": jax.ShapeDtypeStruct((4, 2), jnp.bool_),
-                     "corrupt": jax.ShapeDtypeStruct((4, 2), jnp.bool_),
-                     "participation": jax.ShapeDtypeStruct((4, 2),
-                                                           jnp.bool_)}
-        jax.eval_shape(super_fn, gv, agg_state, x, y, counts, rng,
-                       per_round)
-        programs["engine.superstep[lr,f32,fedavg,k4]"] = 1
-    elif drive == "pipelined":
-        # chaos is on for the pipelined config, so every round carries a
-        # participation mask — only the masked arm ever compiles
-        round_fn = build_round_fn(trainer, cfg, agg)
-        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng, part)
-        programs["engine.round[lr,f32,fedavg,masked]"] = 1
-    elif drive == "buffered":
-        # codec-on admit variants ride the same drive config (a
-        # --update_codec run reaches them); k matches the COMMS-budget twin
-        programs.update(_trace_buffered_programs(
-            trainer, cfg, agg, gv, agg_state, x, y, counts, rng,
-            codecs=_drive_codecs(cfg, codec_k=16)))
-    elif drive == "serving":
-        # graft-serve multiplexes sync (eager) and buffered tenant jobs
-        # over one mesh: its program set is the UNION of both drives —
-        # each tenant's jit wrappers are its own, but the scheduler's
-        # worst-case static footprint is every program both kinds reach,
-        # including per-tenant codec-on variants (JobDescriptor.codec)
+        ltrainer = LoRATrainer(trainer, rank=point.opt("lora_rank"))
+        lgv, lx, ly, lcounts, lrng = _abstract_round_args(
+            ltrainer, ctx["shape"], ctx["in_dtype"])
+        round_l = build_round_fn(ltrainer, cfg, agg)
+        jax.eval_shape(round_l, lgv, jax.eval_shape(agg.init_state, lgv),
+                       lx, ly, lcounts, lrng)
+        return
+    codec = _point_codec(point, cfg)
+    if codec is not None:
+        # codec-wrapped sync round (a codec-on serving tenant reaches it):
+        # the CodecAggregator state is a distinct jit signature
         from fedml_tpu.codecs.transport import CodecAggregator
 
-        round_fn = build_round_fn(trainer, cfg, agg)
-        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
-        programs["engine.round[lr,f32,fedavg]"] = 1
-        codecs = _drive_codecs(cfg, codec_k=16)
-        wrapped = CodecAggregator(codecs[0], agg, slots=2)
+        wrapped = CodecAggregator(codec, agg, slots=2)
         round_c = build_round_fn(trainer, cfg, wrapped)
         jax.eval_shape(round_c, gv, jax.eval_shape(wrapped.init_state, gv),
                        x, y, counts, rng)
-        programs["engine.round[lr,f32,fedavg,int8]"] = 1
-        programs.update(_trace_buffered_programs(
-            trainer, cfg, agg, gv, agg_state, x, y, counts, rng,
-            codecs=codecs))
-    elif drive == "tensor":
-        from jax.sharding import Mesh
+        return
+    round_fn = build_round_fn(trainer, cfg, agg)
+    args = (gv, agg_state, x, y, counts, rng)
+    if point.opt("masked"):
+        # chaos is on for this config, so every round carries a
+        # participation mask — only the masked arm ever compiles
+        args = args + (jax.ShapeDtypeStruct((2,), jnp.bool_),)
+    jax.eval_shape(round_fn, *args)
 
-        from fedml_tpu.parallel.tensor import (TensorSharding,
-                                               build_tensor_round_fn)
-        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
-                    ("clients", "tensor"))
-        sharding = TensorSharding.for_model(mesh, "lr")
-        round_fn = build_tensor_round_fn(
-            trainer, cfg, agg, sharding, donate_state=True)
-        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
-        programs["tensor.round[lr,f32,fedavg,2x4]"] = 1
-        # graft-codec twins: the codec-on round carries the wrapped
-        # {"agg", "codec"} state (per-clients-device residual rows), a
-        # distinct signature per codec; k matches the COMMS-budget twin
-        for codec in _drive_codecs(cfg, codec_k=64):
-            round_c = build_tensor_round_fn(
-                trainer, cfg, agg, sharding, donate_state=True, codec=codec)
 
-            def init_st(g):
-                resid = jax.tree.map(
-                    lambda l: jnp.zeros(
-                        (2,) + (l.shape
-                                if jnp.issubdtype(l.dtype, jnp.inexact)
-                                else ()), l.dtype), g)
-                return {"agg": agg.init_state(g), "codec": resid}
+def _trace_superstep(point, ctx) -> None:
+    """K rounds scanned in ONE program, chaos-armed + stats-collecting as
+    the drive builds it (collect_stats always on in FedAvgAPI)."""
+    from fedml_tpu.algorithms.engine import build_superstep_fn
 
-            jax.eval_shape(round_c, gv, jax.eval_shape(init_st, gv),
-                           x, y, counts, rng)
-            programs[f"tensor.round[lr,f32,fedavg,2x4,{codec.name}]"] = 1
+    k = point.opt("rounds")
+    scfg = FedConfig(model="lr", batch_size=2, epochs=1,
+                     dtype="float32", client_num_per_round=2,
+                     rounds_per_dispatch=k)
+    super_fn = build_superstep_fn(
+        ctx["trainer"], scfg, ctx["agg"], k, client_num_in_total=2,
+        collect_stats=True, chaos_armed=True)
+
+    def i32(shape=()):
+        return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+    per_round = {"round_idx": i32((k,)), "idx": i32((k, 2)),
+                 "nan": jax.ShapeDtypeStruct((k, 2), jnp.bool_),
+                 "corrupt": jax.ShapeDtypeStruct((k, 2), jnp.bool_),
+                 "participation": jax.ShapeDtypeStruct((k, 2), jnp.bool_)}
+    jax.eval_shape(super_fn, ctx["gv"], ctx["agg_state"], ctx["x"],
+                   ctx["y"], ctx["counts"], ctx["rng"], per_round)
+
+
+def _trace_tensor_point(point, ctx) -> None:
+    """tensor.round (plus its codec twins carrying the wrapped
+    {"agg","codec"} state) and the --shard_step tensor.step round."""
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.tensor import (TensorSharding,
+                                           build_tensor_round_fn,
+                                           build_tensor_step_round_fn)
+
+    trainer, cfg, agg = ctx["trainer"], ctx["cfg"], ctx["agg"]
+    gv, x, y = ctx["gv"], ctx["x"], ctx["y"]
+    counts, rng, agg_state = ctx["counts"], ctx["rng"], ctx["agg_state"]
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(point.opt("mesh")),
+                ("clients", "tensor"))
+    sharding = TensorSharding.for_model(mesh, "lr")
+    if point.family == "tensor.step":
         # --shard_step twin: the GSPMD activation-sharded round
         # (build_tensor_step_round_fn) replacing the shard_map round
-        from fedml_tpu.parallel.tensor import build_tensor_step_round_fn
-
         cfg_ss = FedConfig(model="lr", batch_size=2, epochs=1,
                            dtype="float32", tensor_shards=4,
                            shard_step=True)
         round_ss = build_tensor_step_round_fn(
             trainer, cfg_ss, agg, sharding, donate_state=False)
         jax.eval_shape(round_ss, gv, agg_state, x, y, counts, rng)
-        programs["tensor.step[lr,f32,fedavg,2x4]"] = 1
-    elif drive == "sharded":
-        from jax.sharding import Mesh
+        return
+    codec = _point_codec(point, cfg)
+    if codec is None:
+        round_fn = build_tensor_round_fn(
+            trainer, cfg, agg, sharding, donate_state=True)
+        jax.eval_shape(round_fn, gv, agg_state, x, y, counts, rng)
+        return
+    # graft-codec twins: the codec-on round carries the wrapped
+    # {"agg", "codec"} state (per-clients-device residual rows), a
+    # distinct signature per codec; k matches the COMMS-budget twin
+    round_c = build_tensor_round_fn(
+        trainer, cfg, agg, sharding, donate_state=True, codec=codec)
 
-        from fedml_tpu.codecs.transport import CodecAggregator
-        from fedml_tpu.parallel.sharded import build_sharded_round_fn
-        mesh = Mesh(np.array(jax.devices()[:8]), ("clients",))
-        c = 8
-        sharded_args = (
-            jax.ShapeDtypeStruct((c, 4) + shape[1:], in_dtype),
-            jax.ShapeDtypeStruct((c, 4), jnp.int32),
-            jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+    def init_st(g):
+        resid = jax.tree.map(
+            lambda l: jnp.zeros(
+                (2,) + (l.shape
+                        if jnp.issubdtype(l.dtype, jnp.inexact)
+                        else ()), l.dtype), g)
+        return {"agg": agg.init_state(g), "codec": resid}
+
+    jax.eval_shape(round_c, gv, jax.eval_shape(init_st, gv),
+                   x, y, counts, rng)
+
+
+def _trace_sharded_point(point, ctx) -> None:
+    """The shard_map round and its codec twins (CodecAggregator state, one
+    residual row per cohort slot, sharded over 'clients'). EVERY codec
+    level the spec arms traces here — the hand enumeration's [:1] slice
+    was exactly how the topk twin stayed ungated."""
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.sharded import build_sharded_round_fn
+
+    trainer, cfg, agg = ctx["trainer"], ctx["cfg"], ctx["agg"]
+    gv, rng = ctx["gv"], ctx["rng"]
+    c = point.opt("mesh")[0]
+    mesh = Mesh(np.array(jax.devices()[:c]), ("clients",))
+    sharded_args = (
+        jax.ShapeDtypeStruct((c, 4) + ctx["shape"][1:], ctx["in_dtype"]),
+        jax.ShapeDtypeStruct((c, 4), jnp.int32),
+        jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+    codec = _point_codec(point, cfg)
+    if codec is None:
         round_fn = build_sharded_round_fn(trainer, cfg, agg, mesh)
-        jax.eval_shape(round_fn, gv, agg_state, *sharded_args)
-        programs["sharded.round[lr,f32,fedavg,8]"] = 1
-        # codec-on twin: shard_map round with the CodecAggregator state
-        # (one residual row per cohort slot, sharded over 'clients')
-        for codec in _drive_codecs(cfg, codec_k=64)[:1]:
-            wrapped = CodecAggregator(codec, agg, slots=c)
-            round_c = build_sharded_round_fn(trainer, cfg, wrapped, mesh)
-            jax.eval_shape(round_c, gv,
-                           jax.eval_shape(wrapped.init_state, gv),
-                           *sharded_args)
-            programs[f"sharded.round[lr,f32,fedavg,8,{codec.name}]"] = 1
-    elif drive == "hierarchical":
-        from jax.sharding import Mesh
+        jax.eval_shape(round_fn, gv, ctx["agg_state"], *sharded_args)
+        return
+    from fedml_tpu.codecs.transport import CodecAggregator
 
-        from fedml_tpu.parallel.hierarchical import (
-            build_sharded_hierarchical_round_fn)
-        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
-                    ("groups", "clients"))
-        round_fn = build_sharded_hierarchical_round_fn(
-            trainer, cfg, mesh, group_comm_round=2)
-        g, c, n = 2, 4, 4
-        jax.eval_shape(round_fn, gv,
-                       jax.ShapeDtypeStruct((g, c, n) + shape[1:], in_dtype),
-                       jax.ShapeDtypeStruct((g, c, n), jnp.int32),
-                       jax.ShapeDtypeStruct((g, c), jnp.int32), rng)
-        # the hierarchical drive has its own runner (no FedAvgAPI evals)
-        return {"hier.round[lr,f32,2x4]": 1}
-    elif drive == "silo":
-        # silo grouping needs convs to group — mirror the jaxpr target
-        programs["silo.round[resnet20,bf16,fedavg]"] = 1
-        jaxpr = round_jaxpr("resnet20", "bfloat16", "fedavg",
-                            silo_threshold=32)
-        del jaxpr
+    wrapped = CodecAggregator(codec, agg, slots=c)
+    round_c = build_sharded_round_fn(trainer, cfg, wrapped, mesh)
+    jax.eval_shape(round_c, gv, jax.eval_shape(wrapped.init_state, gv),
+                   *sharded_args)
 
-    programs.update(_drive_eval_programs(trainer, shape, in_dtype, gv, rng))
+
+def _trace_hier_point(point, ctx) -> None:
+    from jax.sharding import Mesh
+
+    from fedml_tpu.parallel.hierarchical import (
+        build_sharded_hierarchical_round_fn)
+
+    g, c = point.opt("mesh")
+    mesh = Mesh(np.array(jax.devices()[:g * c]).reshape(g, c),
+                ("groups", "clients"))
+    round_fn = build_sharded_hierarchical_round_fn(
+        ctx["trainer"], ctx["cfg"], mesh, group_comm_round=2)
+    n = 4
+    jax.eval_shape(round_fn, ctx["gv"],
+                   jax.ShapeDtypeStruct((g, c, n) + ctx["shape"][1:],
+                                        ctx["in_dtype"]),
+                   jax.ShapeDtypeStruct((g, c, n), jnp.int32),
+                   jax.ShapeDtypeStruct((g, c), jnp.int32), ctx["rng"])
+
+
+def _trace_silo_point(point, ctx) -> None:
+    # silo grouping needs convs to group — mirror the jaxpr target
+    jaxpr = round_jaxpr(point.opt("model"), point.opt("dtype"), "fedavg",
+                        silo_threshold=32)
+    del jaxpr
+
+
+def enumerate_drive_programs(drive: str) -> dict:
+    """{program name: distinct signature count} for one registered drive
+    config — the static half of the compile budget, DERIVED from the
+    declarative spec (core/spec.py DRIVE_SPECS): every declared
+    ProgramPoint is traced through the real builders, so the enumeration
+    crashes the moment a signature arm drifts, and the budget names are
+    the spec's names. All programs trace on the lr/f32/fedavg example
+    (signature COUNT does not depend on the model), except silo which
+    needs a conv model to group."""
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.core.spec import DRIVE_SPECS, EVAL_POINTS, drive_points
+
+    if drive not in DRIVE_SPECS:
+        raise ValueError(f"unknown drive config {drive!r}; "
+                         f"known: {sorted(DRIVE_SPECS)}")
+    trainer, shape, in_dtype = _tiny_trainer("lr", "float32")
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32")
+    agg = make_aggregator("fedavg", cfg)
+    gv, x, y, counts, rng = _abstract_round_args(trainer, shape, in_dtype)
+    ctx = {"trainer": trainer, "shape": shape, "in_dtype": in_dtype,
+           "cfg": cfg, "agg": agg, "gv": gv, "x": x, "y": y,
+           "counts": counts, "rng": rng,
+           "agg_state": jax.eval_shape(agg.init_state, gv)}
+
+    tracers = {"engine.round": _trace_engine_round,
+               "engine.superstep": _trace_superstep,
+               "tensor.round": _trace_tensor_point,
+               "tensor.step": _trace_tensor_point,
+               "sharded.round": _trace_sharded_point,
+               "hier.round": _trace_hier_point,
+               "silo.round": _trace_silo_point}
+    eval_families = {p.family for p in EVAL_POINTS}
+
+    programs = {}
+    buffered_points = []
+    for point in drive_points(drive):
+        if point.family in eval_families:
+            continue  # the shared evals trace once, below
+        if point.family.startswith("buffered."):
+            buffered_points.append(point)
+            continue
+        tracers[point.family](point, ctx)
+        programs[point.name] = point.signatures
+    if buffered_points:
+        # the buffered family traces as one group (admit needs the client
+        # step's result shapes); codec-on admit twins ride the declared
+        # codec levels — k matches the COMMS-budget twin
+        codecs = [_point_codec(p, cfg) for p in buffered_points
+                  if p.family == "buffered.admit" and p.opt("codec")]
+        traced = _trace_buffered_programs(
+            trainer, cfg, agg, gv, ctx["agg_state"], x, y, counts, rng,
+            codecs=codecs)
+        declared = {p.name: p.signatures for p in buffered_points}
+        if set(traced) != set(declared):
+            raise RuntimeError(
+                f"buffered tracer/spec drift for drive {drive!r}: traced "
+                f"{sorted(traced)} != declared {sorted(declared)}")
+        programs.update(traced)
+    if DRIVE_SPECS[drive].evals:
+        programs.update(_drive_eval_programs(trainer, shape, in_dtype,
+                                             gv, rng))
     return dict(sorted(programs.items()))
 
 

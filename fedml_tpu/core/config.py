@@ -178,6 +178,20 @@ class FedConfig:
     def replace(self, **kw) -> "FedConfig":
         return dataclasses.replace(self, **kw)
 
+    def validate(self, **axes: str) -> "FedConfig":
+        """Raise ValueError for the first feature-axis exclusion (or
+        fused-kernel requirement) this config violates — a lookup into the
+        ONE compatibility table in core/spec.py (graft-matrix). Keyword
+        args overlay non-config axis levels when the caller knows them,
+        e.g. ``cfg.validate(chaos="on")``. Returns self so call sites can
+        chain. Construction stays unchecked on purpose: tests and the
+        analysis matrix build illegal configs to prove they are rejected
+        at validation time."""
+        from fedml_tpu.core.spec import validate_config
+
+        validate_config(self, axes=axes or None)
+        return self
+
     @classmethod
     def from_dict(cls, d: dict) -> "FedConfig":
         names = {f.name for f in dataclasses.fields(cls)}

@@ -1,0 +1,636 @@
+"""graft-matrix: the declarative round-program spec (ROADMAP item 5).
+
+One table for the whole feature matrix. Every cross-cutting feature axis
+(drive backend, silo grouping, tensor sharding, LoRA, the fused kernel,
+buffered aggregation, the round pipeline, the multi-round superstep, the
+update codec, the aggregator rule, chaos masking, ledger stats) is declared
+ONCE here — its legal levels, how a level projects onto `FedConfig`, and a
+single centralized compatibility relation (`EXCLUSIONS` + `REQUIREMENTS`).
+`FedConfig.validate()` and the formerly-scattered per-module `ValueError`s
+in algorithms/fedavg.py and algorithms/engine.py are lookups into these
+tables, so exclusion logic exists in exactly one place and the analysis
+layer can *enumerate* what the runtime *enforces*.
+
+The second half of the table is the program surface: `DRIVE_SPECS` declares,
+per registered drive config, the budget-pinned programs that drive's loop
+can reach — base points plus codec twins EXPANDED from the codec axis
+(``codec_twins``), not hand-listed per drive. `analysis/targets.py` derives
+`enumerate_drive_programs` from these points (byte-identical names to the
+hand enumeration it replaced), and `analysis/matrix_engine.py` (--matrix)
+cross-checks COMPILE_BUDGET.json / COMMS_BUDGET.json coverage against them:
+a reachable point nobody pinned is a finding, as is a stale pin no legal
+config can reach. Expanding the sharded drive's codec twins from the axis
+(all armed levels, not a hand slice) is exactly what surfaced
+``sharded.round[lr,f32,fedavg,8,topk64]`` — reachable since graft-codec
+(the shard_map branch wraps ANY codec), pinned only now.
+
+This module imports neither jax nor FedConfig at module scope — validation
+must stay import-cheap from core/config.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Tuple
+
+# --------------------------------------------------------------------- axes
+
+
+@dataclass(frozen=True)
+class Axis:
+    """One feature axis: its legal levels and how a level projects onto
+    FedConfig fields. `overrides` is None for axes that are NOT config
+    fields (aggregator name, chaos arming, stats collection — those ride
+    constructor args / builder kwargs, see ASSEMBLERS below)."""
+
+    name: str
+    levels: Tuple[str, ...]
+    default: str
+    overrides: Optional[Mapping[str, Mapping[str, Any]]]
+    doc: str
+
+
+AXES: Dict[str, Axis] = {a.name: a for a in (
+    Axis("backend", ("vmap", "shard_map"), "vmap",
+         {"vmap": {"backend": "vmap"},
+          "shard_map": {"backend": "shard_map"}},
+         "single-chip vmap engine vs the 1-D 'clients' shard_map mesh"),
+    Axis("silo", ("off", "on"), "off",
+         {"off": {"silo_threshold": 0}, "on": {"silo_threshold": 32}},
+         "silo-grouped conv execution (ResNetCifar models, one chip)"),
+    Axis("tensor", ("off", "shards", "shard_step"), "off",
+         {"off": {"tensor_shards": 0},
+          "shards": {"tensor_shards": 4},
+          "shard_step": {"tensor_shards": 4, "shard_step": True}},
+         "2-D ('clients','tensor') mesh: storage-sharded round, or the "
+         "GSPMD activation-sharded client step on top of it"),
+    Axis("lora", ("off", "on"), "off",
+         {"off": {"lora_rank": 0}, "on": {"lora_rank": 8}},
+         "federate rank-r adapters only (models/lora.py seam)"),
+    Axis("fused", ("off", "on"), "off",
+         {"off": {"fused_kernel": False}, "on": {"fused_kernel": True}},
+         "the pallas fused-SGD epoch kernel replacing the vmap round"),
+    Axis("buffer", ("off", "on"), "off",
+         {"off": {"buffer_size": 0}, "on": {"buffer_size": 5}},
+         "staleness-aware buffered aggregation (FedBuff admit/commit)"),
+    Axis("pipeline", ("off", "on"), "off",
+         {"off": {"pipeline_depth": 0}, "on": {"pipeline_depth": 2}},
+         "async round pipeline: staged cohorts donated into the round"),
+    Axis("superstep", ("off", "on"), "off",
+         {"off": {"rounds_per_dispatch": 1},
+          "on": {"rounds_per_dispatch": 4}},
+         "K federated rounds fused into one scanned device program"),
+    Axis("codec", ("none", "int8", "topk"), "none",
+         {"none": {"update_codec": "none"},
+          "int8": {"update_codec": "int8"},
+          "topk": {"update_codec": "topk"}},
+         "compressed update transport (graft-codec)"),
+    Axis("aggregator", ("fedavg", "fedopt", "robust", "fednova"), "fedavg",
+         None, "server aggregation rule (FedAvgAPI aggregator_name arg)"),
+    Axis("chaos", ("off", "on"), "off",
+         None, "in-round participation mask + quarantine (FaultPlan arm)"),
+    Axis("stats", ("off", "on"), "off",
+         None, "per-cohort ledger stats rows (collect_stats builder kwarg)"),
+)}
+
+
+def _tensor_level(cfg) -> str:
+    if cfg.tensor_shards > 0:
+        return "shard_step" if getattr(cfg, "shard_step", False) else "shards"
+    return "off"
+
+
+# FedConfig -> axis level, per config-backed axis (non-config axes always
+# project to their default: the config cannot see them).
+_PROJECTIONS: Dict[str, Callable] = {
+    "backend": lambda cfg: cfg.backend,
+    "silo": lambda cfg: "on" if cfg.silo_threshold > 0 else "off",
+    "tensor": _tensor_level,
+    "lora": lambda cfg: "on" if getattr(cfg, "lora_rank", 0) > 0 else "off",
+    "fused": lambda cfg: "on" if getattr(cfg, "fused_kernel", False)
+             else "off",
+    "buffer": lambda cfg: "on" if cfg.buffer_size > 0 else "off",
+    "pipeline": lambda cfg: "on" if cfg.pipeline_depth > 0 else "off",
+    "superstep": lambda cfg: "on" if cfg.rounds_per_dispatch > 1 else "off",
+    "codec": lambda cfg: cfg.update_codec,
+}
+
+
+def axis_levels(cfg) -> Dict[str, str]:
+    """Project a FedConfig onto the axis table (non-config axes default)."""
+    return {name: (_PROJECTIONS[name](cfg) if name in _PROJECTIONS
+                   else axis.default)
+            for name, axis in AXES.items()}
+
+
+def point_config(levels: Mapping[str, str], **extra):
+    """A representative FedConfig at a matrix point (config axes only)."""
+    from fedml_tpu.core.config import FedConfig  # late: config imports us
+
+    overrides: Dict[str, Any] = dict(model="lr", batch_size=2, epochs=1,
+                                     dtype="float32")
+    for axis in AXES.values():
+        if axis.overrides is None:
+            continue
+        overrides.update(axis.overrides[levels.get(axis.name, axis.default)])
+    overrides.update(extra)
+    return FedConfig(**overrides)
+
+
+# --------------------------------------------- the compatibility relation
+
+
+@dataclass(frozen=True)
+class Exclusion:
+    """Levels of `axis_a` that cannot combine with levels of `axis_b`.
+    `reason` is the exact ValueError text `validate_config` raises — the
+    strings the test suite (and users' tracebacks) match on, preserved
+    verbatim from the per-module checks this table replaced."""
+
+    axis_a: str
+    levels_a: Tuple[str, ...]
+    axis_b: str
+    levels_b: Tuple[str, ...]
+    reason: str
+
+
+_CODEC_ON = ("int8", "topk")
+_TENSOR_ON = ("shards", "shard_step")
+
+_BUFFER_REASON = (
+    "buffer_size (staleness-aware buffered aggregation) drives "
+    "the single-controller vmap engine; the sharded admit/commit "
+    "twin (parallel.sharded.build_sharded_buffer_fns) is a "
+    "program-level building block — combine buffer_size with "
+    "neither backend='shard_map', tensor_shards, nor "
+    "silo_threshold")
+_SUPERSTEP_REASON = (
+    "rounds_per_dispatch (the multi-round superstep) fuses K "
+    "rounds into ONE program on the single-chip vmap engine — "
+    "there is no per-round host gap left for the pipeline or "
+    "buffer to exploit, and the sharded/silo/fused lowerings "
+    "have no superstep twin; combine it with none of "
+    "pipeline_depth / buffer_size / backend='shard_map' / "
+    "tensor_shards / silo_threshold / fused_kernel")
+_TENSOR_REASON = (
+    "tensor_shards already places rounds on its own 2D "
+    "('clients', 'tensor') mesh — combine it with neither "
+    "silo_threshold nor backend='shard_map'")
+
+# Order matters: for a config violating several pairs, the FIRST matching
+# exclusion's reason is raised — the order below mirrors the firing order
+# of the scattered checks this table replaced (fedavg.py, then engine.py's
+# fused gate), so existing tracebacks and test matches are unchanged.
+EXCLUSIONS: Tuple[Exclusion, ...] = (
+    Exclusion("codec", _CODEC_ON, "silo", ("on",),
+              "update_codec has no seam in the silo-grouped lowering "
+              "(silos merge clients before any update crosses a wire) — "
+              "drop one of update_codec / silo_threshold"),
+    Exclusion("buffer", ("on",), "backend", ("shard_map",), _BUFFER_REASON),
+    Exclusion("buffer", ("on",), "tensor", _TENSOR_ON, _BUFFER_REASON),
+    Exclusion("buffer", ("on",), "silo", ("on",), _BUFFER_REASON),
+    Exclusion("superstep", ("on",), "pipeline", ("on",), _SUPERSTEP_REASON),
+    Exclusion("superstep", ("on",), "buffer", ("on",), _SUPERSTEP_REASON),
+    Exclusion("superstep", ("on",), "backend", ("shard_map",),
+              _SUPERSTEP_REASON),
+    Exclusion("superstep", ("on",), "tensor", _TENSOR_ON, _SUPERSTEP_REASON),
+    Exclusion("superstep", ("on",), "silo", ("on",), _SUPERSTEP_REASON),
+    Exclusion("superstep", ("on",), "fused", ("on",), _SUPERSTEP_REASON),
+    Exclusion("silo", ("on",), "backend", ("shard_map",),
+              "silo_threshold (the single-chip silo-grouped conv path) "
+              "and backend='shard_map' are mutually exclusive — the "
+              "grouped lowering merges silos on ONE chip; drop one of the "
+              "two settings"),
+    Exclusion("tensor", _TENSOR_ON, "silo", ("on",), _TENSOR_REASON),
+    Exclusion("tensor", _TENSOR_ON, "backend", ("shard_map",),
+              _TENSOR_REASON),
+    Exclusion("fused", ("on",), "tensor", _TENSOR_ON,
+              "--fused_kernel is mutually exclusive with --tensor_shards "
+              "(the kernel owns the whole client step)"),
+    Exclusion("fused", ("on",), "codec", _CODEC_ON,
+              "--fused_kernel is mutually exclusive with --update_codec"),
+    Exclusion("fused", ("on",), "buffer", ("on",),
+              "--fused_kernel is mutually exclusive with --buffer_size "
+              "(buffered admission consumes per-client LocalResults)"),
+    Exclusion("fused", ("on",), "lora", ("on",),
+              "--fused_kernel is mutually exclusive with --lora_rank "
+              "(the kernel trains the raw CNN param layout)"),
+    # The two pairs below were SILENT before graft-matrix: FedAvgAPI's
+    # branch dispatch picked the shard_map / silo round and dropped the
+    # fused flag on the floor — the exact bug class the matrix exists to
+    # surface. They are errors now.
+    Exclusion("fused", ("on",), "backend", ("shard_map",),
+              "--fused_kernel drives the single-chip vmap engine — the "
+              "kernel owns the whole client step and has no shard_map "
+              "lowering; drop one of fused_kernel / backend='shard_map'"),
+    Exclusion("fused", ("on",), "silo", ("on",),
+              "--fused_kernel is mutually exclusive with silo_threshold "
+              "(the kernel owns the whole client step; the silo-grouped "
+              "lowering would repack it)"),
+    # Runtime gates lifted into the table (the matrix's trace probes found
+    # them firing deep inside builders/round bodies — now they are also
+    # config-time answers). Reasons verbatim from the runtime raises.
+    Exclusion("codec", _CODEC_ON, "tensor", ("shard_step",),
+              "--shard_step runs under GSPMD automatic partitioning — the "
+              "codec transports are manual shard_map collectives and do "
+              "not compose with it. Drop --shard_step (the storage-sharded "
+              "tensor round supports codecs) or --update_codec."),
+    Exclusion("fused", ("on",), "chaos", ("on",),
+              "the fused kernel round has no participation/quarantine "
+              "stage — run without chaos faults or cohort padding, or "
+              "drop --fused_kernel"),
+)
+
+
+@dataclass(frozen=True)
+class Constraint:
+    """An n-ary exclusion: illegal when EVERY clause ``(axis, levels)``
+    holds simultaneously. The pairwise EXCLUSIONS stay pairwise (that is
+    what users trip and tests match); this table exists for the few
+    genuinely three-way interactions the trace probes surfaced."""
+
+    clauses: Tuple[Tuple[str, Tuple[str, ...]], ...]
+    reason: str
+
+
+CONSTRAINTS: Tuple[Constraint, ...] = (
+    # parallel/tensor.py's codec gate: the storage-sharded round decodes
+    # updates before aggregation, and robust/fednova must see RAW deltas
+    Constraint(
+        (("tensor", _TENSOR_ON), ("codec", _CODEC_ON),
+         ("aggregator", ("robust", "fednova"))),
+        "update codecs on the tensor path support fedavg/fedopt only: "
+        "robust clips whole-tree norms of raw client deltas and fednova "
+        "recombines per-client taus — both would silently run on "
+        "already-decoded values"),
+    # CodecAggregator._stage (codecs/transport.py) maps deltas over the
+    # FULL federated tree, but the LoRA client step ships adapters only —
+    # the engine/shard_map codec wrap dies on the asymmetric trees at
+    # trace time (Dict key mismatch). Two paths ARE adapter-aware: the
+    # tensor-sharded round (parallel/tensor.py, its lora8,topk64 twin is
+    # COMMS-pinned) and the buffered admit, whose memoryless delta runs
+    # against the stripped dispatch base (algorithms/buffered.py passes
+    # strip_lora_base(globals); tests/test_lora.py pins LoRA x topk on
+    # the buffered drive end-to-end).
+    Constraint(
+        (("codec", _CODEC_ON), ("lora", ("on",)), ("tensor", ("off",)),
+         ("buffer", ("off",))),
+        "update codecs reach LoRA runs only through the tensor-sharded "
+        "round or buffered admission (the adapter-aware transports in "
+        "parallel/tensor.py and the buffered admit) — the vmap/shard_map "
+        "CodecAggregator stages deltas for the full federated tree while "
+        "the LoRA client step ships adapters only; drop one of "
+        "update_codec / lora_rank, or add --tensor_shards / --buffer_size"),
+)
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """A value constraint that applies when `axis` sits at `level` —
+    e.g. the fused kernel's sgd/epochs/grad_clip demands. `check` takes
+    the FedConfig and returns True when satisfied."""
+
+    axis: str
+    level: str
+    check: Callable
+    reason: str
+
+
+REQUIREMENTS: Tuple[Requirement, ...] = (
+    Requirement("fused", "on",
+                lambda cfg: (cfg.client_optimizer == "sgd"
+                             and not cfg.momentum and not cfg.wd
+                             and not cfg.fedprox_mu),
+                "the fused kernel implements plain SGD with global-norm "
+                "clip — sgd, momentum 0, wd 0, fedprox_mu 0 required"),
+    Requirement("fused", "on", lambda cfg: cfg.epochs == 1,
+                "the fused kernel runs exactly one local epoch"),
+    Requirement("fused", "on", lambda cfg: cfg.grad_clip is not None,
+                "the fused kernel clips unconditionally (reference "
+                "semantics) — grad_clip must be set"),
+)
+
+
+def _level(levels: Mapping[str, str], axis: str) -> str:
+    return levels.get(axis, AXES[axis].default)
+
+
+def first_violation(levels: Mapping[str, str]):
+    """The first EXCLUSIONS (then CONSTRAINTS) entry an axis-level
+    assignment violates — both carry ``.reason``; None when legal."""
+    for exc in EXCLUSIONS:
+        if (_level(levels, exc.axis_a) in exc.levels_a
+                and _level(levels, exc.axis_b) in exc.levels_b):
+            return exc
+    for con in CONSTRAINTS:
+        if all(_level(levels, axis) in lvls for axis, lvls in con.clauses):
+            return con
+    return None
+
+
+def is_legal(levels: Mapping[str, str]) -> bool:
+    return first_violation(levels) is None
+
+
+def validate_config(cfg, axes: Optional[Mapping[str, str]] = None) -> None:
+    """Raise ValueError (with the table's reason) for the first exclusion
+    or requirement `cfg` violates. `axes` overlays non-config axis levels
+    (aggregator/chaos/stats) when the caller knows them. This is the ONE
+    compatibility check — FedConfig.validate(), FedAvgAPI.__init__ and
+    engine.build_round_fn's fused gate all delegate here."""
+    levels = axis_levels(cfg)
+    if axes:
+        levels.update(axes)
+    exc = first_violation(levels)
+    if exc is not None:
+        raise ValueError(exc.reason)
+    for req in REQUIREMENTS:
+        if levels.get(req.axis) == req.level and not req.check(cfg):
+            raise ValueError(req.reason)
+
+
+# ------------------------------------------------------- program surface
+
+
+@dataclass(frozen=True)
+class ProgramPoint:
+    """One budget-pinned program: a name (family prefix + bracketed parts,
+    e.g. ``sharded.round[lr,f32,fedavg,8,int8]``), the axis levels it
+    exercises, its distinct-jit-signature count, and tracer options
+    (codec/k/lora/mesh/...) consumed by analysis/targets.py."""
+
+    family: str
+    parts: Tuple[str, ...]
+    axes: Tuple[Tuple[str, str], ...] = ()
+    signatures: int = 1
+    opts: Tuple[Tuple[str, Any], ...] = ()
+
+    @property
+    def name(self) -> str:
+        return f"{self.family}[{','.join(self.parts)}]"
+
+    def opt(self, key: str, default=None):
+        return dict(self.opts).get(key, default)
+
+    def level(self, axis: str) -> str:
+        return dict(self.axes).get(axis, AXES[axis].default)
+
+
+def codec_tag(level: str, k: int) -> str:
+    """The budget-name tag of a codec axis level at a drive's COMMS-twin k
+    (``int8`` carries no k; ``topk`` pins it: ``topk64``)."""
+    return "int8" if level == "int8" else f"topk{k}"
+
+
+@dataclass(frozen=True)
+class CodecTwin:
+    """Codec-on twins of `base`, EXPANDED from the codec axis: one twin
+    per armed level, named by appending ``codec_tag(level, k)``. Arming
+    `levels` is a statement about the runtime ("this drive's loop wraps
+    any of these codecs"), so a missing budget pin becomes a matrix
+    finding instead of a silent gap."""
+
+    base: ProgramPoint
+    levels: Tuple[str, ...]
+    k: int
+
+    def expand(self) -> Tuple[ProgramPoint, ...]:
+        return tuple(
+            ProgramPoint(
+                self.base.family,
+                self.base.parts + (codec_tag(level, self.k),),
+                self.base.axes + (("codec", level),),
+                self.base.signatures,
+                self.base.opts + (("codec", level), ("codec_k", self.k)))
+            for level in self.levels)
+
+
+@dataclass(frozen=True)
+class DriveSpec:
+    """One registered drive config's reachable program surface."""
+
+    drive: str
+    points: Tuple[ProgramPoint, ...]
+    codec_twins: Tuple[CodecTwin, ...] = ()
+    evals: bool = True
+
+
+# the three eval programs every FedAvgAPI drive shares (targets.py traces
+# them; federation_eval has two signatures — Train/Test splits pack to
+# different n_max)
+EVAL_POINTS: Tuple[ProgramPoint, ...] = (
+    ProgramPoint("engine.eval", ("lr", "f32")),
+    ProgramPoint("engine.client_eval", ("lr", "f32")),
+    ProgramPoint("engine.federation_eval", ("lr", "f32"), signatures=2),
+)
+
+_ENGINE_BASE = ProgramPoint("engine.round", ("lr", "f32", "fedavg"))
+_ADMIT_BASE = ProgramPoint("buffered.admit", ("lr", "f32"),
+                           axes=(("buffer", "on"),))
+_BUFFERED_BASE = (
+    ProgramPoint("buffered.client_step", ("lr", "f32"),
+                 axes=(("buffer", "on"),)),
+    _ADMIT_BASE,
+    ProgramPoint("buffered.commit", ("lr", "f32", "fedavg"),
+                 axes=(("buffer", "on"),)),
+)
+_TENSOR_BASE = ProgramPoint("tensor.round", ("lr", "f32", "fedavg", "2x4"),
+                            axes=(("tensor", "shards"),),
+                            opts=(("mesh", (2, 4)),))
+_SHARDED_BASE = ProgramPoint("sharded.round", ("lr", "f32", "fedavg", "8"),
+                             axes=(("backend", "shard_map"),),
+                             opts=(("mesh", (8,)),))
+
+DRIVE_SPECS: Dict[str, DriveSpec] = {s.drive: s for s in (
+    DriveSpec("eager", ( _ENGINE_BASE,)),
+    DriveSpec("pipelined", (
+        ProgramPoint("engine.round", ("lr", "f32", "fedavg", "masked"),
+                     axes=(("pipeline", "on"), ("chaos", "on")),
+                     opts=(("masked", True),)),)),
+    DriveSpec("finetune", (
+        ProgramPoint("engine.round", ("lr", "f32", "fedavg", "lora8"),
+                     axes=(("lora", "on"),), opts=(("lora_rank", 8),)),
+        ProgramPoint("engine.round", ("cnn", "f32", "fedavg", "fused"),
+                     axes=(("fused", "on"),),
+                     opts=(("fused", True), ("model", "cnn"))),
+        ProgramPoint("engine.superstep", ("lr", "f32", "fedavg", "k4"),
+                     axes=(("superstep", "on"), ("chaos", "on"),
+                           ("stats", "on")),
+                     opts=(("rounds", 4),)),)),
+    DriveSpec("buffered", _BUFFERED_BASE,
+              codec_twins=(CodecTwin(_ADMIT_BASE, ("int8", "topk"), 16),)),
+    DriveSpec("serving", (_ENGINE_BASE,) + _BUFFERED_BASE,
+              codec_twins=(
+                  # sync-tenant topk is structurally reachable too
+                  # (JobDescriptor.codec rides update_codec into the vmap
+                  # wrap) but deliberately outside the pinned static
+                  # surface — see SCOPE_NOTES
+                  CodecTwin(_ENGINE_BASE, ("int8",), 16),
+                  CodecTwin(_ADMIT_BASE, ("int8", "topk"), 16))),
+    DriveSpec("tensor", (
+        _TENSOR_BASE,
+        ProgramPoint("tensor.step", ("lr", "f32", "fedavg", "2x4"),
+                     axes=(("tensor", "shard_step"),),
+                     opts=(("mesh", (2, 4)),))),
+              codec_twins=(CodecTwin(_TENSOR_BASE, ("int8", "topk"), 64),)),
+    DriveSpec("sharded", (_SHARDED_BASE,),
+              # ALL armed codec levels: the shard_map branch wraps any
+              # codec (fedavg.py CodecAggregator), so the topk twin is as
+              # reachable as the int8 one — the hand enumeration's [:1]
+              # slice had silently left it ungated
+              codec_twins=(CodecTwin(_SHARDED_BASE, ("int8", "topk"), 64),)),
+    DriveSpec("hierarchical", (
+        ProgramPoint("hier.round", ("lr", "f32", "2x4"),
+                     axes=(("backend", "shard_map"),),
+                     opts=(("mesh", (2, 4)),)),), evals=False),
+    DriveSpec("silo", (
+        ProgramPoint("silo.round", ("resnet20", "bf16", "fedavg"),
+                     axes=(("silo", "on"),),
+                     opts=(("model", "resnet20"), ("dtype", "bfloat16"))),)),
+)}
+
+# Deliberate static-surface scope decisions — the matrix engine echoes
+# these in MATRIX.json instead of flagging them ungated. Each one names a
+# reachable-but-unpinned program family and the reason it stays unpinned;
+# deleting a note without pinning the program turns it into a finding.
+SCOPE_NOTES: Tuple[Tuple[str, str], ...] = (
+    ("eager:codec",
+     "an eager --update_codec run wraps the vmap round "
+     "(engine.round[lr,f32,fedavg,int8/topk*]) but the eager drive's "
+     "max_compiles ceiling pins the codec-OFF loop; the codec-on sync "
+     "program is budget-pinned under the serving drive instead"),
+    ("serving:sync-topk",
+     "a sync tenant with update_codec='topk' reaches "
+     "engine.round[lr,f32,fedavg,topk16]; the pinned serving surface "
+     "carries the int8 sync tenant as the codec-on representative — arm "
+     "the topk level in DRIVE_SPECS['serving'] when a topk sync tenant "
+     "lands"),
+)
+
+
+def drive_points(drive: str) -> Tuple[ProgramPoint, ...]:
+    """Every budget-pinned ProgramPoint of one drive config (base points,
+    expanded codec twins, shared evals)."""
+    spec = DRIVE_SPECS[drive]
+    points = list(spec.points)
+    for twin in spec.codec_twins:
+        points.extend(twin.expand())
+    if spec.evals:
+        points.extend(EVAL_POINTS)
+    return tuple(points)
+
+
+def drive_program_names(drive: str) -> Dict[str, int]:
+    return {p.name: p.signatures for p in drive_points(drive)}
+
+
+def all_reachable_programs() -> Dict[str, List[str]]:
+    """program name -> drives that reach it, over every DRIVE_SPECS entry."""
+    out: Dict[str, List[str]] = {}
+    for drive in DRIVE_SPECS:
+        for p in drive_points(drive):
+            out.setdefault(p.name, []).append(drive)
+    return out
+
+
+def parse_program_name(name: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """``family[p1,p2,...]`` -> (family, parts); None when malformed."""
+    if not name.endswith("]") or "[" not in name:
+        return None
+    family, _, rest = name.partition("[")
+    parts = tuple(rest[:-1].split(","))
+    return (family, parts) if family and all(parts) else None
+
+
+# The HLO-layer (COMMS_BUDGET.json) surface: analysis/comms.py PROGRAMS
+# keys, declared here so the matrix engine can cross-check both directions
+# (a comms PROGRAMS entry the spec does not declare, or a declared name
+# comms.py no longer builds, is drift — matrix_engine asserts set
+# equality against the live module).
+COMMS_PROGRAM_NAMES: Tuple[str, ...] = (
+    "sharded.round[lr,f32,fedavg]",
+    "sharded.round[lr,f32,fedopt]",
+    "sharded.round[lr,f32,robust]",
+    "sharded.round[lr,f32,fednova]",
+    "hier.round[lr,f32,2x4]",
+    "tensor.round[tformer,f32,fedavg,2x4]",
+    "tensor.round[tformer,f32,fedopt,2x4]",
+    "tensor.round[lr,f32,robust,2x4]",
+    "tensor.round[lr,f32,fednova,2x4]",
+    "tensor.round[tformer,f32,fedavg,2x4,int8]",
+    "tensor.round[tformer,f32,fedavg,2x4,topk64]",
+    "tensor.round[tformer,f32,fedavg,2x4,lora8]",
+    "tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]",
+    "tensor.step[tformer,f32,2x4]",
+    "tensor.step[tformer,f32,2x4,replicated]",
+    "buffered.admit[lr,f32]",
+    "buffered.admit[lr,f32,int8]",
+    "buffered.admit[lr,f32,topk16]",
+    "buffered.commit[lr,f32,fedavg]",
+    "buffered.commit[lr,f32,fedopt]",
+    "gossip.mix[ring8]",
+    "sequence.ring[b1,t64,h8,d16]",
+    "sequence.ulysses[b1,t64,h8,d16]",
+    "engine.round[lr,f32,fedavg]",
+    "engine.chunked.chunk_fn[lr]",
+)
+
+
+# --------------------------------------------------- assembler kwarg table
+
+
+# the feature-axis kwargs that are threaded through round assemblers by
+# hand (the axis-drift rule's universe) — everything else in a signature
+# is plumbing (trainer/cfg/aggregator/mesh), not a feature axis
+AXIS_KWARGS: frozenset = frozenset({
+    "donate_data", "donate_state", "param_sharding", "collect_stats",
+    "codec", "chaos_armed", "in_graph_sampling",
+})
+
+
+@dataclass(frozen=True)
+class AssemblerSpec:
+    """One round assembler and the feature-axis kwargs its signature MUST
+    carry per this spec. `note` documents deliberate absences (silo's
+    missing collect_stats is a decision, not drift) — the axis-drift rule
+    flags only divergence between a signature and this table."""
+
+    module: str       # repo-relative path
+    func: str
+    axis_kwargs: Tuple[str, ...]
+    note: str = ""
+
+
+ASSEMBLERS: Tuple[AssemblerSpec, ...] = (
+    AssemblerSpec("fedml_tpu/algorithms/engine.py", "build_round_fn",
+                  ("donate_data", "param_sharding", "collect_stats",
+                   "codec")),
+    AssemblerSpec("fedml_tpu/algorithms/engine.py",
+                  "build_round_fn_from_update",
+                  ("donate_data", "collect_stats")),
+    AssemblerSpec("fedml_tpu/algorithms/engine.py", "build_superstep_fn",
+                  ("collect_stats", "chaos_armed", "in_graph_sampling")),
+    AssemblerSpec("fedml_tpu/algorithms/buffered.py", "build_client_step_fn",
+                  ("donate_data", "collect_stats"),
+                  note="codec lives at admit (build_buffer_admit), not in "
+                       "the cohort step"),
+    AssemblerSpec("fedml_tpu/parallel/sharded.py", "build_sharded_round_fn",
+                  ("collect_stats",),
+                  note="codec rides the CodecAggregator wrap (FedAvgAPI), "
+                       "not a builder kwarg; cohorts are mesh-resident so "
+                       "there is no donate seam"),
+    AssemblerSpec("fedml_tpu/parallel/tensor.py", "build_tensor_round_fn",
+                  ("donate_state", "donate_data", "collect_stats", "codec")),
+    AssemblerSpec("fedml_tpu/parallel/tensor.py",
+                  "build_tensor_step_round_fn",
+                  ("donate_state", "donate_data", "collect_stats", "codec")),
+    AssemblerSpec("fedml_tpu/parallel/hierarchical.py",
+                  "build_sharded_hierarchical_round_fn", (),
+                  note="two-level group round: no stats (outputs are "
+                       "group-major, not cohort-aligned) and no codec seam"),
+    AssemblerSpec("fedml_tpu/algorithms/silo_grouped.py",
+                  "build_silo_round_fn", (),
+                  note="silo outputs don't align with the cohort axis — "
+                       "no ledger stats by design (fedavg.py sets "
+                       "_round_has_stats=False); no codec seam"),
+)

@@ -148,6 +148,8 @@ def test_degenerate_fedopt_tracks_sync_momenta_in_fast_suite(ds8):
                                        atol=1e-6, rtol=0)
 
 
+@pytest.mark.slow  # ~14s default-codegen subprocess recompile; the same
+# degenerate identity runs at opt-0 in the two fast-suite tests above
 def test_degenerate_fedopt_bitwise_at_default_codegen():
     """The ISSUE-9 acceptance pin, verbatim: degenerate buffered config
     bit-identical to the sync fedavg AND fedopt loops (params AND momenta,

@@ -272,6 +272,8 @@ def test_psum_aggregation_halves_all_gather_bytes():
         f"{gather_bytes}B — the sharded.py comment is now a lie")
 
 
+@pytest.mark.slow  # ~22s full-surface lowering; ci_smoke's --comms step
+# lowers the same programs AND gates the budgets on every push
 def test_all_parallel_programs_lower_clean():
     # every shard_map round lowers on the virtual mesh with zero HLO-rule
     # findings (budget gate excluded — that needs compiled memory numbers)

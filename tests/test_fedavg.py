@@ -4,6 +4,8 @@ Oracle 1 (reference CI-script-fedavg.sh:44-50): full-batch, E=1 FedAvg over
 all clients equals centralized full-batch GD to tight tolerance.
 """
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -147,6 +149,12 @@ def test_multi_round_scan_sampling_subset(mnist10):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.skipif(
+    "xla_backend_optimization_level=0" in os.environ.get("XLA_FLAGS", ""),
+    reason="bit-identity holds only at default XLA codegen: the fast "
+           "suite's opt-0 flag (tests/conftest.py) reassociates the "
+           "weighted-mean reduction (~3e-8 drift); covered by --runslow / "
+           "FEDML_TPU_RUN_SLOW=1 runs, which keep default codegen")
 def test_assume_full_clients_bit_identical():
     """The assume_full_clients specialization must be a pure compile-time
     simplification: on data satisfying the contract (every count == n_max,

@@ -157,6 +157,8 @@ def test_fednas_sweep_counts_ragged_clients():
     assert rec["search_samples"] == cfg.epochs * sum(int(c) // 2 for c in counts)
 
 
+@pytest.mark.slow  # heaviest DARTS compile in the module (~80s); the val-half
+# gating logic is also covered by the search_samples assert above
 def test_fednas_arch_step_skipped_without_val_half():
     """A count==1 client has no validation half; its 'val' batch would be
     padding. The arch step must be a no-op there (ADVICE r2): a federation of

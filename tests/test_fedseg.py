@@ -65,6 +65,8 @@ def test_lr_schedules(mode):
     assert all(l >= 0 for l in lrs)
 
 
+@pytest.mark.slow  # ~28s segmentation drive; ci_smoke's fedseg CLI step runs
+# the same end-to-end path on every push
 def test_fedseg_end_to_end():
     """Tiny FCN learns a synthetic segmentation task through FedAvgAPI with
     SegmentationTrainer (per-pixel labels + ignore_index)."""

@@ -872,6 +872,9 @@ def test_repo_is_clean_full():
     assert report.ok, "\n" + report.summary()
 
 
+@pytest.mark.slow  # ~27s; ci_smoke's first step runs the identical gate
+# (python -m fedml_tpu.analysis --fast) on every push, so tier-1 keeps only
+# the per-rule unit tests above
 def test_repo_is_clean_fast():
     # engine/silo/darts jaxprs + donation + retrace + partition coverage +
     # the AST sweep over fedml_tpu/ and tools/ (pins the satellite fixes);

@@ -343,6 +343,12 @@ def test_reference_wire_format_interop_both_directions():
     from _reference_oracle import setup_reference
 
     setup_reference()
+    # the living-reference checkout (/root/reference) is not shipped in
+    # every container; without it this interop oracle has nothing to
+    # compare against — same gate as the reference_parity modules
+    pytest.importorskip(
+        "fedml_core.distributed.communication.message",
+        reason="reference FedML checkout (/root/reference) unavailable")
     from fedml_core.distributed.communication.message import Message as RefMessage
     from fedml_api.distributed.fedavg.utils import (
         transform_list_to_tensor,

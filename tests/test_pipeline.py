@@ -115,6 +115,8 @@ def test_guard_rollback_drops_stale_cohorts(ds8):
     assert _strip_times(piped.history) == _strip_times(eager.history)
 
 
+@pytest.mark.slow  # ~11s (12-round eager + depth-4 piped twins); the
+# pipelined==eager bit-identity is pinned by the faster tests above
 def test_pipelined_flush_bounds_pending_backlog(ds8):
     """BENCH_r06 depth-scaling regression pin: without sync points (no
     guard, rare eval), deferred records must still flush once the backlog

@@ -62,6 +62,12 @@ torch = pytest.importorskip("torch")
 from _reference_oracle import setup_reference, torch_batches  # noqa: E402
 
 setup_reference()
+# the living-reference checkout is not shipped in every container;
+# without it the oracle has nothing to run — skip at collect time
+# instead of erroring the whole module
+pytest.importorskip(
+    "fedml_api",
+    reason="reference FedML checkout (/root/reference) unavailable")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

@@ -37,6 +37,12 @@ pytest.importorskip("torch")
 from _reference_oracle import setup_reference  # noqa: E402
 
 setup_reference()
+# the living-reference checkout is not shipped in every container;
+# without it the oracle has nothing to run — skip at collect time
+# instead of erroring the whole module
+pytest.importorskip(
+    "fedml_api",
+    reason="reference FedML checkout (/root/reference) unavailable")
 
 from fedml_api.distributed.turboaggregate import mpc_function as ref  # noqa: E402
 

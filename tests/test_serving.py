@@ -125,6 +125,8 @@ def test_tenant_final_params_bitwise_equal_solo_run(ds8):
 
 # ------------------------------------------------------- fair-share policy
 
+@pytest.mark.slow  # ~10s many-tenant drive; the fair-share policy's
+# correctness is pinned by the cheaper scheduler tests in this module
 def test_fair_share_bounds_dispatch_skew(ds8):
     """Weight 2:1 -> the heavy tenant gets 2 of every 3 ticks while both
     are active, off by at most one in any prefix (deficit round-robin's

@@ -108,6 +108,8 @@ def test_silo_round_matches_engine_trajectory(full):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~11s; the plain-SGD engine-match twin above pins the
+# same silo==engine trajectory in the fast suite
 def test_silo_momentum_optimizer_exact_per_silo():
     """vmapped optimizer = exact per-silo semantics for stateful chains
     (momentum + weight decay): trajectories still match the engine."""
@@ -128,6 +130,8 @@ def test_silo_momentum_optimizer_exact_per_silo():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~10s epochs=2 compile; the LocalResult num_steps
+# contract is structural, not codegen-sensitive — nightly coverage suffices
 def test_silo_round_with_fednova_aggregator():
     """The silo path's LocalResult contract (stacked variables + per-silo
     num_steps) must satisfy non-FedAvg aggregators too — FedNova consumes
@@ -152,6 +156,8 @@ def test_silo_round_with_fednova_aggregator():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~9s K=4 scan compile x2; the single-round engine-match
+# tests above keep the silo numerics pinned in the fast suite
 def test_silo_multi_round_matches_engine_multi_round():
     """The scan-amortized silo path (what bench.py runs) matches the
     engine's multi-round scan, including in-graph client sampling."""

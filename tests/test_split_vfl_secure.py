@@ -2,6 +2,7 @@
 
 import flax.linen as nn
 import numpy as np
+import pytest
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.registry import load_dataset
@@ -197,6 +198,8 @@ class TinyGKTServer(nn.Module):
         return nn.Dense(self.output_dim)(nn.relu(nn.Dense(32)(x)))
 
 
+@pytest.mark.slow  # ~10s two-phase distillation; ci_smoke's fedgkt CLI step
+# runs the same transfer end to end on every push
 def test_fedgkt_knowledge_transfer():
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
 

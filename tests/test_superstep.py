@@ -216,6 +216,8 @@ class _RejectOnce:
         return GuardVerdict(True, "")
 
 
+@pytest.mark.slow  # ~11s (fused + eager replay compiles); the rollback
+# contract is also exercised by ci_smoke's superstep byte-equality step
 def test_superstep_guard_rollback_replays_chunk_eagerly(ds8):
     """A rejection inside a chunk rolls the WHOLE chunk back (params AND
     guard state) and replays it at K=1 — localizing the bad round with the

@@ -113,6 +113,8 @@ def test_tensor_round_matches_vmap_engine(mesh24, ds16):
         assert abs(float(m1[k]) - float(m2[k])) < 1e-3
 
 
+@pytest.mark.slow  # ~10s LSTM compile x2; the lr/cnn/tformer families pin
+# the sharded==replicated identity in the fast suite
 def test_rnn_family_round_bit_identical(mesh24):
     """The rnn rule table drives a real LSTM round: sharded == replicated."""
     cfg = FedConfig(model="rnn", batch_size=4, epochs=1, lr=0.1,
