@@ -40,18 +40,16 @@ EOF
 
 COMMON="--run_dir $RUN_DIR --data_dir ./data --seed 0"
 
-echo "== graft-lint (fails on any new finding; LINT.json is the machine report)"
-# --fast skips the 29-model dtype sweep, which tier-1 runs per-model in
-# tests/test_dtype_registry.py; everything else (engine/silo/darts jaxprs,
-# donation, retrace, partition coverage, AST sweep) runs here
-python -m fedml_tpu.analysis --fast --json LINT.json
-
-echo "== graft-lint HLO layer (collective traffic + memory vs COMMS_BUDGET.json)"
-# lowers every parallel round program on the same 8-virtual-device mesh and
-# gates collective count/bytes and peak memory; --fast skips the two
-# single-chip extras (their zero-collective budgets are pinned by
-# tests/test_comms.py); COMMS.json is the machine report next to LINT.json
-python -m fedml_tpu.analysis --comms --fast --json COMMS.json
+echo "== graft-lint --all (six engines, one summary table, one exit code)"
+# ONE invocation replaces the five sequential engine runs this script used
+# to chain: the jaxpr+AST lint (with the full 29-model dtype sweep), the
+# HLO comms layer vs COMMS_BUDGET.json, the compile layer vs
+# COMPILE_BUDGET.json, the feature-matrix prover vs core/spec.py, and the
+# jaxpr equivalence prover (EQUIV_PAIRS contracts + builder-vs-legacy over
+# the full matrix cover). Any finding in any layer is the single nonzero
+# exit; --json-dir drops every machine report (LINT/COMMS/COMPILE/MATRIX/
+# EQUIV.json) next to the committed copies
+python -m fedml_tpu.analysis --all --json-dir .
 
 echo "== comms budget self-test: a halved tensor-round ceiling must trip"
 # run one tensor program against a doctored budget table (real table with
@@ -121,13 +119,6 @@ print("OK comms budget trips on tensor.step peak regression:",
       finding.message)
 EOF
 
-echo "== graft-lint compile layer (retrace budgets vs COMPILE_BUDGET.json)"
-# enumerates every jit entry point reachable from each drive config and
-# pins the exact compiled-program counts, plus the AST retrace-risk /
-# use-after-donate / rng-key-reuse / lock-discipline sweep; COMPILE.json
-# is the machine report next to LINT.json and COMMS.json
-python -m fedml_tpu.analysis --compile --json COMPILE.json
-
 echo "== compile budget self-test: an extra compile over the ceiling must trip"
 # fold a synthetic trace with one more compile request than the pipelined
 # drive's measured max_compiles — run_compile_gate must FAIL, proving the
@@ -165,15 +156,6 @@ assert any(pin in f.message and "not budgeted" in f.message
 print("OK compile budget trips when the superstep pin is removed")
 EOF
 
-echo "== graft-lint matrix layer (feature matrix vs core/spec.py tables)"
-# enumerates the full legal feature matrix from the declarative spec,
-# abstractly traces a pairwise cover through the real round builders,
-# proves every illegal axis combination raises at config-validation time
-# with the table's reason, cross-checks COMPILE/COMMS budget coverage
-# against the spec's program surface, and runs the axis-drift AST rule
-# over the round assemblers; MATRIX.json is the committed machine report
-python -m fedml_tpu.analysis --matrix --json MATRIX.json
-
 echo "== matrix coverage self-test: an unpinned reachable program must trip"
 # remove the sharded topk64 codec-twin pin (the program this layer first
 # proved reachable) from an in-memory copy of COMPILE_BUDGET.json — the
@@ -195,6 +177,33 @@ hit = [f for f in findings
 assert hit and "not budget-gated" in hit[0].message, findings
 print("OK matrix coverage trips when the sharded topk64 pin is removed:")
 print("  ", hit[0].message)
+EOF
+
+echo "== equiv self-test: a mutated structurally-off contract must trip"
+# flip ONE EQUIV_PAIRS knob in memory — the lora-rank-0 contract's builder
+# side gets lora_rank=2, so it emits a REAL LoRA round against the plain
+# legacy engine round — and the prover must FAIL that contract with a
+# readable divergence (eqn index / signature, primitive, operand
+# provenance), proving the equivalence gate catches real drift and isn't
+# a tautology over shared code paths
+python - <<'EOF'
+import fedml_tpu.core.spec as spec
+from fedml_tpu.analysis.equiv_engine import run_equiv
+spec.EQUIV_PAIRS = tuple(
+    spec.EquivPair(p.name,
+                   spec.EquivSide(p.lhs.kind, p.lhs.levels,
+                                  (("lora_rank", 2),)),
+                   p.rhs, p.doc)
+    if p.name == "lora-rank-0" else p
+    for p in spec.EQUIV_PAIRS)
+report, payload = run_equiv(".", fast=True, targets=["lora-rank-0"])
+assert not report.ok, "mutated lora-rank-0 contract failed to trip"
+[row] = [r for r in payload["pairs"] if r["name"] == "lora-rank-0"]
+assert row["ok"] is False, row
+msg = report.findings[0].message
+assert "divergence" in msg and ("eqn[" in msg or "signature" in msg), msg
+print("OK equiv gate trips on a mutated contract:")
+print("  ", msg.splitlines()[0])
 EOF
 
 echo "== base framework (scalar-sum smoke, CI-script-framework.sh analog)"

@@ -79,22 +79,12 @@ def build_client_step_fn(trainer, cfg, donate_data: bool = False,
 
     telemetry.emit("round_fn_built", program="buffered.client_step",
                    donate=donate_data)
-    if not donate_data:
-        return jax.jit(client_step)
+    from fedml_tpu.core.builder import donating_jit
+
     # x/y are staged fresh per round (and re-staged on a guard retry), so
     # their HBM may be reused in place; counts survives — the admit program
     # reads it long after the step
-    jitted = jax.jit(client_step, donate_argnums=(1, 2))
-
-    def donating_client_step(*args):
-        import warnings
-
-        with warnings.catch_warnings():
-            warnings.filterwarnings("ignore", message=".*onat")
-            return jitted(*args)
-
-    donating_client_step.jitted = jitted  # graft-lint donation introspection
-    return donating_client_step
+    return donating_jit(client_step, (1, 2) if donate_data else ())
 
 
 def init_buffer(result, k: int) -> Dict[str, Any]:

@@ -351,61 +351,13 @@ def cohort_stats(global_variables, result: LocalResult) -> dict:
     }
 
 
-def _round_core(batched_update, aggregator, collect_stats: bool) -> Callable:
-    """The ONE synchronous-round body, shared by build_round_fn_from_update
-    (one round per dispatch) and build_superstep_fn_from_update (K rounds
-    per dispatch, scanned). Both builders trace exactly this function, so
-    the superstep's bit-identity contract with the eager loop holds by
-    construction — there is no second round definition to drift.
-
-    Returns core(gv, agg_state, x, y, counts, rng, participation) ->
-    (new_gv, new_state, metrics, stats-or-None); `participation=None`
-    traces the legacy unmasked program, an array arms the quarantine stage
-    (see build_round_fn_from_update's docstring for the full contract).
-    """
-    # function-level import: aggregators.make_server_optimizer imports
-    # engine.torch_adagrad, so the modules must not need each other at
-    # import time
-    from fedml_tpu.algorithms.aggregators import quarantine_stage
-    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
-
-    def core(global_variables, agg_state, x, y, counts, rng, participation):
-        crngs = jax.random.split(rng, x.shape[0])
-        result = batched_update(global_variables, x, y, counts, crngs)
-        # ledger stats come from the RAW results (pre-quarantine) so the
-        # poisoned rows aggregation zeroes below stay visible per-client
-        stats = cohort_stats(global_variables, result) if collect_stats \
-            else None
-        weights = counts.astype(jnp.float32)
-        if participation is None:
-            new_global, new_state = aggregator(
-                global_variables, result, weights, rng, agg_state
-            )
-            # LoRA: aggregation ran adapters-only (results are stripped);
-            # the server's frozen base re-attaches untouched (no-op when
-            # the trainer isn't wrapped)
-            new_global = attach_lora_base(new_global, global_variables)
-            # per-client metric sums -> federation totals
-            metrics = {k: v.sum() for k, v in result.metrics.items()}
-            return new_global, new_state, metrics, stats
-        result, weights, alive, quarantined = quarantine_stage(
-            result, weights, participation)
-        new_global, new_state = aggregator(
-            global_variables, result, weights, rng, agg_state
-        )
-        any_alive = jnp.any(alive)
-        # the all-dead fallback must match the aggregator output's
-        # (adapters-only under LoRA) structure; base re-attaches after
-        new_global = tree_where(any_alive, new_global,
-                                strip_lora_base(global_variables))
-        new_state = tree_where(any_alive, new_state, agg_state)
-        new_global = attach_lora_base(new_global, global_variables)
-        metrics = {k: v.sum() for k, v in result.metrics.items()}
-        metrics["participated_count"] = alive.sum().astype(jnp.float32)
-        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
-        return new_global, new_state, metrics, stats
-
-    return core
+# The ONE synchronous-round body moved to core/builder.py (ROADMAP item 5:
+# every round assembler composes from the same fragments); the alias keeps
+# this module's builders and docstrings reading naturally. Both round
+# builders here — and parallel/tensor.py's GSPMD step round — trace exactly
+# that function, so the superstep's bit-identity contract with the eager
+# loop holds by construction: there is no second round definition to drift.
+from fedml_tpu.core.builder import build_round_core as _round_core  # noqa: E402
 
 
 def build_round_fn_from_update(batched_update, aggregator,
@@ -456,21 +408,8 @@ def build_round_fn_from_update(batched_update, aggregator,
     telemetry.emit("round_fn_built", program="engine.round",
                    donate=donate_data)
 
-    if not donate_data:
-        return jax.jit(round_fn)
-
-    jitted = jax.jit(round_fn, donate_argnums=(2, 3, 4))
-
-    def donating_round_fn(*args, **kwargs):
-        # backends that can't alias a donated input (CPU for some
-        # shapes/dtypes) warn per compile; the fallback is a plain copy, so
-        # the warning is noise for this opt-in path
-        with warnings.catch_warnings():
-            warnings.filterwarnings("ignore", message=".*onat")
-            return jitted(*args, **kwargs)
-
-    donating_round_fn.jitted = jitted  # graft-lint donation introspection
-    return donating_round_fn
+    from fedml_tpu.core.builder import donating_jit, donation_argnums
+    return donating_jit(round_fn, donation_argnums(donate_data=donate_data))
 
 
 def build_round_fn(trainer, cfg: FedConfig, aggregator,
@@ -578,12 +517,9 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
             donate_state=bool(cfg.extra.get("donate_params", False)),
             donate_data=donate_data, collect_stats=collect_stats,
             codec=codec)
-    if codec is not None:
-        from fedml_tpu.codecs.transport import CodecAggregator
+    from fedml_tpu.core.builder import wrap_codec
 
-        if not isinstance(aggregator, CodecAggregator):
-            aggregator = CodecAggregator(codec, aggregator,
-                                         slots=cfg.client_num_per_round)
+    aggregator = wrap_codec(aggregator, codec, slots=cfg.client_num_per_round)
     return build_round_fn_from_update(_vmapped_update(trainer, cfg),
                                       aggregator, donate_data=donate_data,
                                       collect_stats=collect_stats)

@@ -161,15 +161,15 @@ class FedAvgAPI(Checkpointable):
             shape = (int(np.prod(config.mesh_shape)),) if config.mesh_shape else None
             self.mesh = make_mesh(shape, axis_names=("clients",))
             if self.codec is not None:
-                from fedml_tpu.codecs.transport import CodecAggregator
+                from fedml_tpu.core.builder import wrap_codec
 
                 # residual slots span the PADDED cohort (pad_clients rounds
                 # the width up to a mesh multiple before dispatch)
                 n_ax = self.mesh.shape["clients"]
                 slots = min(config.client_num_per_round, dataset.client_num)
                 slots = -(-slots // n_ax) * n_ax
-                self.aggregator = CodecAggregator(
-                    self.codec, self.aggregator, slots)
+                self.aggregator = wrap_codec(
+                    self.aggregator, self.codec, slots)
             self.round_fn = build_sharded_round_fn(
                 model_trainer, config, self.aggregator, self.mesh,
                 collect_stats=True
@@ -186,7 +186,7 @@ class FedAvgAPI(Checkpointable):
                 config, self.aggregator)
         else:
             if self.codec is not None and config.buffer_size == 0:
-                from fedml_tpu.codecs.transport import CodecAggregator
+                from fedml_tpu.core.builder import wrap_codec
 
                 # sync vmap/pipelined drives: wrap the aggregator HERE (not
                 # inside build_round_fn) so init_state below yields the
@@ -195,8 +195,8 @@ class FedAvgAPI(Checkpointable):
                 # inner aggregator — their codec stage lives at admit
                 # (algorithms/buffered.py), commits aggregate decoded rows.
                 slots = min(config.client_num_per_round, dataset.client_num)
-                self.aggregator = CodecAggregator(
-                    self.codec, self.aggregator, slots)
+                self.aggregator = wrap_codec(
+                    self.aggregator, self.codec, slots)
             # the pipelined drive loop stages a fresh device copy of the
             # cohort every round, so its buffers can be donated into the
             # round; eager callers (bench.py re-feeds one staged cohort)

@@ -31,6 +31,16 @@ the flag surface:
   `--fast` traces one cover point per round family; `--update-budgets`
   rewrites COMPILE_BUDGET.json from the spec-derived enumeration
   (static counts only). `--json` writes MATRIX.json.
+- `--equiv`: the equivalence layer — prove the spec's EQUIV_PAIRS
+  structurally-off contracts and prove core/builder.build_round_program
+  emits canonically identical jaxprs to the preserved legacy hand
+  assembly for every matrix cover point. `--fast` proves one cover
+  point per round family (contracts always run in full); `--target
+  SUBSTR` filters both parts. `--json` writes EQUIV.json.
+- `--all`: every engine in sequence with ONE summary table and a single
+  nonzero exit when any engine finds anything. `--json-dir DIR` writes
+  each engine's machine-readable report (LINT/COMMS/COMPILE/MATRIX/
+  EQUIV.json) into DIR; `--fast` applies per engine as above.
 
 Run from anywhere — the repo root is derived from the package location.
 """
@@ -68,13 +78,26 @@ def main(argv=None) -> int:
                         "feature matrix from core/spec.py, trace a pairwise "
                         "cover, prove every illegal combination raises, "
                         "cross-check budget coverage, lint axis drift")
+    p.add_argument("--equiv", action="store_true",
+                   help="run the equivalence layer instead: prove the "
+                        "EQUIV_PAIRS structurally-off contracts and prove "
+                        "core/builder.build_round_program canonically "
+                        "identical to the legacy hand assembly over the "
+                        "matrix cover")
+    p.add_argument("--all", action="store_true", dest="all_engines",
+                   help="run every engine in sequence: one summary table, "
+                        "single nonzero exit when any engine fires")
     p.add_argument("--target", action="append", metavar="SUBSTR",
                    help="(--comms) only lower programs whose name contains "
                         "SUBSTR; (--compile) only these drive configs; "
+                        "(--equiv) only contracts/cover points matching; "
                         "repeatable")
     p.add_argument("--update-budgets", action="store_true",
                    help="(--comms/--compile) rewrite the budget file from "
                         "measurement instead of gating against it")
+    p.add_argument("--json-dir", metavar="DIR", default=None,
+                   help="(--all) write each engine's report (LINT/COMMS/"
+                        "COMPILE/MATRIX/EQUIV.json) into DIR")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -82,81 +105,144 @@ def main(argv=None) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
+    if args.all_engines:
+        return _run_all_engines(repo_root, args)
+
     if args.matrix:
-        # same mesh contract as --comms/--compile: tracing the sharded /
-        # tensor / hierarchical families needs 8 virtual devices, set
-        # before jax initializes its backend
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-
-        from fedml_tpu.analysis.matrix_engine import (format_matrix_table,
-                                                      run_matrix)
-
-        report, matrix = run_matrix(
-            repo_root, fast=args.fast, update_budgets=args.update_budgets)
+        _force_host_devices()
+        report, text, _, matrix = _engine_matrix(repo_root, args)
         if args.json:
-            with open(args.json, "w") as f:
-                json.dump(matrix, f, indent=2)
-                f.write("\n")
-        print(format_matrix_table(matrix))
-        print(report.summary())
+            _write_json(args.json, matrix)
+        print(text)
+        return 0 if report.ok else 1
+
+    if args.equiv:
+        _force_host_devices()
+        report, text, _, payload = _engine_equiv(repo_root, args)
+        if args.json:
+            _write_json(args.json, payload)
+        print(text)
         return 0 if report.ok else 1
 
     if args.compile_layer:
-        # same mesh contract as --comms: the tensor/sharded/hierarchical
-        # drive programs need 8 virtual devices, set before jax initializes
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-
-        from fedml_tpu.analysis.compile_engine import (format_compile_table,
-                                                       load_budgets,
-                                                       run_compile)
-
-        report, measured = run_compile(
-            repo_root, fast=args.fast, targets=args.target,
-            update_budgets=args.update_budgets,
-            measure=args.update_budgets and not args.fast)
+        _force_host_devices()
+        report, text, _, out = _engine_compile(repo_root, args)
         if args.json:
-            out = {"drives": measured, "lint": report.to_dict()}
-            with open(args.json, "w") as f:
-                json.dump(out, f, indent=2)
-                f.write("\n")
-        print(format_compile_table(measured, load_budgets(repo_root)))
-        print(report.summary())
+            _write_json(args.json, out)
+        print(text)
         return 0 if report.ok else 1
 
     if args.comms:
-        # must land before jax initializes its backend — run_comms re-checks
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-
-        from fedml_tpu.analysis.comms import format_comms_table, run_comms
-
-        report, comms = run_comms(
-            repo_root, fast=args.fast, targets=args.target,
-            update_budgets=args.update_budgets)
+        _force_host_devices()
+        report, text, _, comms = _engine_comms(repo_root, args)
         if args.json:
-            with open(args.json, "w") as f:
-                json.dump(comms, f, indent=2)
-                f.write("\n")
-        print(format_comms_table(comms["programs"]))
-        print(report.summary())
+            _write_json(args.json, comms)
+        print(text)
         return 0 if report.ok else 1
 
+    report, text, _, payload = _engine_lint(repo_root, args)
+    if args.json:
+        _write_json(args.json, payload)
+    print(text)
+    return 0 if report.ok else 1
+
+
+def _force_host_devices() -> None:
+    """8 virtual host devices for the sharded/tensor/hierarchical meshes —
+    must land before jax initializes its backend (the engines re-check)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _write_json(path: str, payload) -> None:
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def _engine_lint(repo_root, args):
     from fedml_tpu.analysis.targets import run_all
 
     report = run_all(repo_root, include_models=not args.fast,
                      include_ast=not args.no_ast)
-    if args.json:
-        report.write_json(args.json)
-    print(report.summary())
-    return 0 if report.ok else 1
+    return report, report.summary(), "LINT.json", report.to_dict()
+
+
+def _engine_comms(repo_root, args):
+    from fedml_tpu.analysis.comms import format_comms_table, run_comms
+
+    report, comms = run_comms(
+        repo_root, fast=args.fast, targets=args.target,
+        update_budgets=args.update_budgets)
+    text = format_comms_table(comms["programs"]) + "\n" + report.summary()
+    return report, text, "COMMS.json", comms
+
+
+def _engine_compile(repo_root, args):
+    from fedml_tpu.analysis.compile_engine import (format_compile_table,
+                                                   load_budgets, run_compile)
+
+    report, measured = run_compile(
+        repo_root, fast=args.fast, targets=args.target,
+        update_budgets=args.update_budgets,
+        measure=args.update_budgets and not args.fast)
+    out = {"drives": measured, "lint": report.to_dict()}
+    text = (format_compile_table(measured, load_budgets(repo_root))
+            + "\n" + report.summary())
+    return report, text, "COMPILE.json", out
+
+
+def _engine_matrix(repo_root, args):
+    from fedml_tpu.analysis.matrix_engine import (format_matrix_table,
+                                                  run_matrix)
+
+    report, matrix = run_matrix(
+        repo_root, fast=args.fast, update_budgets=args.update_budgets)
+    text = format_matrix_table(matrix) + "\n" + report.summary()
+    return report, text, "MATRIX.json", matrix
+
+
+def _engine_equiv(repo_root, args):
+    from fedml_tpu.analysis.equiv_engine import format_equiv_table, run_equiv
+
+    report, payload = run_equiv(repo_root, fast=args.fast,
+                                targets=args.target)
+    text = format_equiv_table(payload) + "\n" + report.summary()
+    return report, text, "EQUIV.json", payload
+
+
+def _run_all_engines(repo_root, args) -> int:
+    """Every engine in sequence, one process (the virtual-device mesh is
+    set up front so every layer sees the same 8-device backend), one
+    summary table, one exit code."""
+    _force_host_devices()
+    engines = [
+        ("graft-lint", _engine_lint),
+        ("comms", _engine_comms),
+        ("compile", _engine_compile),
+        ("matrix", _engine_matrix),
+        ("equiv", _engine_equiv),
+    ]
+    rows, failed = [], False
+    for name, run in engines:
+        report, text, json_name, payload = run(repo_root, args)
+        print(f"== {name} " + "=" * max(0, 66 - len(name)))
+        print(text)
+        if args.json_dir:
+            _write_json(os.path.join(args.json_dir, json_name), payload)
+        rows.append((name, len(report.findings), len(report.checked)))
+        failed = failed or not report.ok
+    w = max(len(r[0]) for r in rows)
+    print("== summary " + "=" * 63)
+    print(f"{'engine':<{w}}  findings  targets")
+    for name, n_find, n_tgt in rows:
+        print(f"{name:<{w}}  {n_find:>8}  {n_tgt:>7}")
+    print("graft-lint --all: "
+          + ("FINDINGS" if failed else "clean")
+          + f" across {len(rows)} layers")
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

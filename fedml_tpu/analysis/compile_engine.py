@@ -80,7 +80,11 @@ _DONATING_FACTORIES = {
     "build_buffer_admit": ("donate_buffer", (0,)),
 }
 
-_KEY_SOURCES = {"PRNGKey", "fold_in", "split", "key", "wrap_key_data"}
+_KEY_SOURCES = {"PRNGKey", "fold_in", "split", "key", "wrap_key_data",
+                # the in-graph Feistel sampler's host-side key schedule
+                # (algorithms/sampling.py): a derived per-round block is
+                # itself a key — deriving is blessed, replaying one fires
+                "feistel_keys_block", "feistel_round_keys", "split_keys"}
 
 
 def _dotted(node) -> Optional[str]:

@@ -82,6 +82,14 @@ RULES = {
                       "that is not in EVENT_SCHEMAS (the call raises "
                       "ValueError the first time it fires at runtime — "
                       "often in a rarely-hit error path)",
+    # Equivalence-layer rules (equiv_engine / --equiv): canonical-jaxpr
+    # identity proofs over core/builder.py's composed round programs.
+    "equiv-contract": "a spec.EQUIV_PAIRS structurally-off contract broke: "
+                      "the two sides trace to canonically different jaxprs "
+                      "(first divergence reported eqn-by-eqn)",
+    "equiv-divergence": "core/builder.build_round_program emits a "
+                        "canonically different jaxpr than the preserved "
+                        "legacy hand assembly for a matrix cover point",
 }
 
 # Suppression grammar: `# graft-lint: disable=rule1,rule2 -- reason`.

@@ -102,46 +102,12 @@ def pairwise_cover(legal: Sequence[Mapping[str, str]]
 # 2. tracing the cover through the real builders
 # ---------------------------------------------------------------------------
 
-# Which round family a legal assignment lowers to (mirrors FedAvgAPI's
-# branch dispatch in algorithms/fedavg.py), and which axes actually REACH
-# that family's builder — the rest ride host-side (pipeline staging, the
-# chaos arrival plan) or are excluded by the tables, so they cannot alter
-# the traced program and are deduplicated out of the cover.
-_FAMILY_TRACE_AXES: Dict[str, Tuple[str, ...]] = {
-    "engine": ("aggregator", "codec", "lora", "chaos", "stats", "pipeline"),
-    "fused": ("aggregator", "stats", "pipeline"),
-    "superstep": ("aggregator", "codec", "lora", "chaos", "stats"),
-    "buffered": ("aggregator", "codec", "lora", "stats", "pipeline"),
-    "sharded": ("aggregator", "codec", "lora", "stats"),
-    "tensor_round": ("aggregator", "codec", "lora", "stats", "pipeline"),
-    "tensor_step": ("aggregator", "lora", "stats", "pipeline"),
-    "silo": ("aggregator", "lora"),
-}
-
-
-def point_family(levels: Mapping[str, str]) -> str:
-    """The round family FedAvgAPI's dispatch picks for this assignment."""
-    if levels.get("fused") == "on":
-        return "fused"
-    if levels.get("superstep") == "on":
-        return "superstep"
-    if levels.get("buffer") == "on":
-        return "buffered"
-    if levels.get("backend") == "shard_map":
-        return "sharded"
-    if levels.get("tensor") == "shards":
-        return "tensor_round"
-    if levels.get("tensor") == "shard_step":
-        return "tensor_step"
-    if levels.get("silo") == "on":
-        return "silo"
-    return "engine"
-
-
-def trace_key(levels: Mapping[str, str]) -> Tuple:
-    fam = point_family(levels)
-    return (fam,) + tuple(
-        (a, levels.get(a, "off")) for a in _FAMILY_TRACE_AXES[fam])
+# The family-dispatch tables moved to core/spec.py with the rest of the
+# declarative surface (core/builder.py composes from them too); re-exported
+# here for the existing import surface (tests/test_matrix.py pins the
+# dispatch order through these names).
+from fedml_tpu.core.spec import (_FAMILY_TRACE_AXES,  # noqa: F401
+                                 point_family, trace_key)
 
 
 def _non_config_overlay(levels: Mapping[str, str]) -> Dict[str, str]:
@@ -152,149 +118,20 @@ def _non_config_overlay(levels: Mapping[str, str]) -> Dict[str, str]:
 
 
 def trace_point(levels: Mapping[str, str]) -> None:
-    """Abstractly trace (jax.eval_shape) the round program one legal
-    matrix point builds — through the same builders the runtime uses, on
-    the lr/f32 example (resnet20/bf16 for silo, cnn for fused). Raises on
-    any structural incompatibility the tables failed to declare."""
+    """Abstractly trace (jax.eval_shape) the round program(s) one legal
+    matrix point builds — composed by core/builder.py from the spec point,
+    through the same builders the runtime uses, on the lr/f32 example
+    (resnet20/bf16 for silo, cnn for fused). Raises on any structural
+    incompatibility the tables failed to declare. The hand-assembled twin
+    this delegation replaced lives on in analysis/equiv_engine.py as
+    `legacy_round_programs`, the certification baseline --equiv proves the
+    builder against."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from fedml_tpu.algorithms.aggregators import make_aggregator
-    from fedml_tpu.analysis.targets import (_abstract_round_args,
-                                            _tiny_trainer,
-                                            _trace_buffered_programs)
-    from fedml_tpu.codecs import make_codec
-    from fedml_tpu.core.spec import point_config, validate_config
+    from fedml_tpu.core.builder import build_round_program
 
-    fam = point_family(levels)
-    stats = levels.get("stats") == "on"
-    donate = levels.get("pipeline") == "on"
-    chaos = levels.get("chaos") == "on"
-    model, dtype, extra = "lr", "float32", {}
-    if fam == "silo":
-        model, dtype = "resnet20", "bfloat16"
-    elif fam == "fused":
-        model = "cnn"
-    elif fam == "superstep":
-        extra["client_num_per_round"] = 2
-    cfg = point_config(levels, model=model, dtype=dtype, **extra)
-    # the legality round-trip: the point the tables call legal must also
-    # pass config-time validation with the non-config levels overlaid
-    validate_config(cfg, axes=_non_config_overlay(levels))
-
-    trainer, shape, in_dtype = _tiny_trainer(model, dtype)
-    if levels.get("lora") == "on":
-        from fedml_tpu.models.lora import LoRATrainer
-
-        trainer = LoRATrainer(trainer, rank=cfg.lora_rank)
-    agg = make_aggregator(levels.get("aggregator", "fedavg"), cfg)
-    codec = (make_codec(cfg.update_codec, cfg)
-             if levels.get("codec", "none") != "none" else None)
-    gv, x, y, counts, rng = _abstract_round_args(trainer, shape, in_dtype)
-    agg_state = jax.eval_shape(agg.init_state, gv)
-    mask = jax.ShapeDtypeStruct((2,), jnp.bool_)
-
-    if fam in ("engine", "fused"):
-        from fedml_tpu.algorithms.engine import build_round_fn
-
-        rule = agg
-        if codec is not None:
-            from fedml_tpu.codecs.transport import CodecAggregator
-
-            rule = CodecAggregator(codec, agg, slots=2)
-            agg_state = jax.eval_shape(rule.init_state, gv)
-        fn = build_round_fn(trainer, cfg, rule, donate_data=donate,
-                            collect_stats=stats)
-        args = (gv, agg_state, x, y, counts, rng)
-        if chaos and fam == "engine":     # fused x chaos is table-illegal
-            args = args + (mask,)
-        jax.eval_shape(fn, *args)
-    elif fam == "superstep":
-        from fedml_tpu.algorithms.engine import build_superstep_fn
-
-        rule = agg
-        if codec is not None:
-            from fedml_tpu.codecs.transport import CodecAggregator
-
-            rule = CodecAggregator(codec, agg, slots=2)
-            agg_state = jax.eval_shape(rule.init_state, gv)
-        k = cfg.rounds_per_dispatch
-        fn = build_superstep_fn(trainer, cfg, rule, k,
-                                client_num_in_total=2, collect_stats=stats,
-                                chaos_armed=chaos)
-
-        def i32(s=()):
-            return jax.ShapeDtypeStruct(s, jnp.int32)
-
-        per_round = {"round_idx": i32((k,)), "idx": i32((k, 2)),
-                     "nan": jax.ShapeDtypeStruct((k, 2), jnp.bool_),
-                     "corrupt": jax.ShapeDtypeStruct((k, 2), jnp.bool_),
-                     "participation": jax.ShapeDtypeStruct((k, 2),
-                                                           jnp.bool_)}
-        jax.eval_shape(fn, gv, agg_state, x, y, counts, rng, per_round)
-    elif fam == "buffered":
-        _trace_buffered_programs(
-            trainer, cfg, agg, gv, agg_state, x, y, counts, rng,
-            codecs=[codec] if codec is not None else ())
-    elif fam == "sharded":
-        from jax.sharding import Mesh
-
-        from fedml_tpu.parallel.sharded import build_sharded_round_fn
-
-        rule = agg
-        if codec is not None:
-            from fedml_tpu.codecs.transport import CodecAggregator
-
-            rule = CodecAggregator(codec, agg, slots=8)
-            agg_state = jax.eval_shape(rule.init_state, gv)
-        mesh = Mesh(np.array(jax.devices()[:8]), ("clients",))
-        fn = build_sharded_round_fn(trainer, cfg, rule, mesh,
-                                    collect_stats=stats)
-        jax.eval_shape(
-            fn, gv, agg_state,
-            jax.ShapeDtypeStruct((8, 4) + shape[1:], in_dtype),
-            jax.ShapeDtypeStruct((8, 4), jnp.int32),
-            jax.ShapeDtypeStruct((8,), jnp.int32), rng)
-    elif fam in ("tensor_round", "tensor_step"):
-        from jax.sharding import Mesh
-
-        from fedml_tpu.parallel.tensor import (TensorSharding,
-                                               build_tensor_round_fn,
-                                               build_tensor_step_round_fn)
-
-        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
-                    ("clients", "tensor"))
-        sharding = TensorSharding.for_model(mesh, "lr")
-        build = (build_tensor_step_round_fn if fam == "tensor_step"
-                 else build_tensor_round_fn)
-        fn = build(trainer, cfg, agg, sharding, donate_state=False,
-                   donate_data=donate, collect_stats=stats, codec=codec)
-        if codec is not None:
-            from fedml_tpu.models.lora import strip_lora_base
-
-            def init_st(g):
-                # the residual mirrors the WIRE tree — adapters-only
-                # under LoRA (same contract as analysis/comms.py)
-                fed = strip_lora_base(g)
-                resid = jax.tree.map(
-                    lambda l: jnp.zeros(
-                        (2,) + (l.shape
-                                if jnp.issubdtype(l.dtype, jnp.inexact)
-                                else ()), l.dtype), fed)
-                return {"agg": agg.init_state(g), "codec": resid}
-
-            agg_state = jax.eval_shape(init_st, gv)
-        jax.eval_shape(fn, gv, agg_state, x, y, counts, rng)
-    elif fam == "silo":
-        from fedml_tpu.algorithms.silo_grouped import (build_silo_round_fn,
-                                                       silo_trainer)
-
-        st = silo_trainer(trainer, cfg.silo_threshold)
-        fn = build_silo_round_fn(st, cfg, agg)
-        jax.eval_shape(fn, gv, agg_state, x, y, counts, rng)
-    else:       # pragma: no cover - dispatch is total over the families
-        raise AssertionError(f"unknown family {fam!r}")
+    for prog in build_round_program(levels):
+        jax.eval_shape(prog.fn, *prog.args)
 
 
 def trace_legal_cover(cover: Sequence[Mapping[str, str]],

@@ -349,6 +349,54 @@ def validate_config(cfg, axes: Optional[Mapping[str, str]] = None) -> None:
             raise ValueError(req.reason)
 
 
+# ------------------------------------------------------- family dispatch
+
+
+# Which axes actually REACH each round family's builder — the rest ride
+# host-side (pipeline staging, the chaos arrival plan) or are excluded by
+# the tables, so they cannot alter the traced program. Consumed by the
+# matrix engine's cover dedup and by core/builder.py's composition.
+_FAMILY_TRACE_AXES: Dict[str, Tuple[str, ...]] = {
+    "engine": ("aggregator", "codec", "lora", "chaos", "stats", "pipeline"),
+    "fused": ("aggregator", "stats", "pipeline"),
+    "superstep": ("aggregator", "codec", "lora", "chaos", "stats"),
+    "buffered": ("aggregator", "codec", "lora", "stats", "pipeline"),
+    "sharded": ("aggregator", "codec", "lora", "stats"),
+    "tensor_round": ("aggregator", "codec", "lora", "stats", "pipeline"),
+    "tensor_step": ("aggregator", "lora", "stats", "pipeline"),
+    "silo": ("aggregator", "lora"),
+}
+
+
+def point_family(levels: Mapping[str, str]) -> str:
+    """The round family FedAvgAPI's dispatch picks for this assignment
+    (mirrors the branch order in algorithms/fedavg.py — pinned by
+    tests/test_matrix.py::test_point_family_mirrors_fedavg_dispatch_order)."""
+    if levels.get("fused") == "on":
+        return "fused"
+    if levels.get("superstep") == "on":
+        return "superstep"
+    if levels.get("buffer") == "on":
+        return "buffered"
+    if levels.get("backend") == "shard_map":
+        return "sharded"
+    if levels.get("tensor") == "shards":
+        return "tensor_round"
+    if levels.get("tensor") == "shard_step":
+        return "tensor_step"
+    if levels.get("silo") == "on":
+        return "silo"
+    return "engine"
+
+
+def trace_key(levels: Mapping[str, str]) -> Tuple:
+    """Dedup key for traced programs: family plus the levels of the axes
+    that reach its builder."""
+    fam = point_family(levels)
+    return (fam,) + tuple(
+        (a, levels.get(a, "off")) for a in _FAMILY_TRACE_AXES[fam])
+
+
 # ------------------------------------------------------- program surface
 
 
@@ -633,4 +681,92 @@ ASSEMBLERS: Tuple[AssemblerSpec, ...] = (
                   note="silo outputs don't align with the cohort axis — "
                        "no ledger stats by design (fedavg.py sets "
                        "_round_has_stats=False); no codec seam"),
+)
+
+
+# -------------------------------------------- structural-identity contracts
+
+
+@dataclass(frozen=True)
+class EquivSide:
+    """One side of an equivalence contract: which assembly path emits the
+    program (`builder` = core/builder.py's spec-point composition,
+    `legacy` = the hand assembly preserved in analysis/equiv_engine.py as
+    the certification baseline), at which axis levels, with which extra
+    FedConfig overrides layered on top of the levels' projections."""
+
+    kind: str                                       # "builder" | "legacy"
+    levels: Tuple[Tuple[str, str], ...] = ()
+    extra: Tuple[Tuple[str, Any], ...] = ()
+
+
+@dataclass(frozen=True)
+class EquivPair:
+    """A standing structural-identity contract: the two sides must trace to
+    the SAME canonical jaxpr (analysis/equiv_engine.py proves it, program
+    by program). These are the repo's `structurally off == exact legacy
+    program` claims, previously asserted only by running twin programs."""
+
+    name: str
+    lhs: EquivSide
+    rhs: EquivSide
+    doc: str
+
+
+EQUIV_PAIRS: Tuple[EquivPair, ...] = (
+    EquivPair(
+        "codec-none.engine",
+        EquivSide("builder", (("codec", "none"),)),
+        EquivSide("legacy"),
+        "the builder's one codec seam at level `none` emits the "
+        "hand-assembled vmap round — codec-off rounds carry zero codec "
+        "residue in the traced program"),
+    EquivPair(
+        "codec-none.sharded",
+        EquivSide("builder", (("backend", "shard_map"), ("codec", "none"))),
+        EquivSide("legacy", (("backend", "shard_map"),)),
+        "codec-off shard_map round: the unwrapped aggregator keeps the "
+        "exact legacy P() state spec and psum program"),
+    EquivPair(
+        "codec-none.tensor",
+        EquivSide("builder", (("tensor", "shards"), ("codec", "none"))),
+        EquivSide("legacy", (("tensor", "shards"),)),
+        "codec-off tensor-sharded round: no quantized-gather/int8-psum "
+        "collectives appear when the codec level is none"),
+    EquivPair(
+        "codec-none.buffered",
+        EquivSide("builder", (("buffer", "on"), ("codec", "none"))),
+        EquivSide("legacy", (("buffer", "on"),)),
+        "codec-off buffered admission: the admit program takes no trailing "
+        "delta base and moves full-width f32 rows"),
+    EquivPair(
+        "mask-omitted.engine",
+        EquivSide("builder", (("pipeline", "on"),)),
+        EquivSide("legacy"),
+        "participation=None traces the exact legacy unmasked program — no "
+        "masking ops, no extra metric keys — and cohort donation "
+        "(pipeline staging) changes buffer aliasing only, never the "
+        "computation (donated_invars are normalized away)"),
+    EquivPair(
+        "tensor-shards-1",
+        EquivSide("builder", (("tensor", "shard_step"),),
+                  (("tensor_shards", 1),)),
+        EquivSide("legacy"),
+        "at tensor_shards=1 the GSPMD activation-sharded step is "
+        "structurally the plain vmap engine round — sharding constraints "
+        "over a size-1 axis are placement no-ops (normalized away)"),
+    EquivPair(
+        "superstep-k1",
+        EquivSide("builder", (("superstep", "on"),),
+                  (("rounds_per_dispatch", 1),)),
+        EquivSide("legacy"),
+        "rounds_per_dispatch=1 NEVER builds the superstep scan — the "
+        "builder emits the plain eager round program (the structurally-"
+        "off path in algorithms/fedavg.py's dispatch)"),
+    EquivPair(
+        "lora-rank-0",
+        EquivSide("builder", (("lora", "on"),), (("lora_rank", 0),)),
+        EquivSide("legacy"),
+        "lora_rank=0 is the identity wrap: maybe_wrap_lora returns the "
+        "trainer unchanged and the round federates the full tree"),
 )

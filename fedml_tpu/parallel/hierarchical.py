@@ -34,6 +34,7 @@ from fedml_tpu.algorithms.aggregators import (
     tree_weighted_sum_psum,
 )
 from fedml_tpu.algorithms.engine import build_local_update
+from fedml_tpu.core.builder import shard_key_slice
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import pcast, shard_map
 from fedml_tpu.utils.pytree import tree_where
@@ -82,8 +83,7 @@ def build_sharded_hierarchical_round_fn(
         gidx = jax.lax.axis_index(group_axis)
         cidx = jax.lax.axis_index(client_axis)
         # same group-key table as the vmap engine: split(rng, G)[g]
-        all_grngs = jax.random.split(rng, g_total)
-        grngs = jax.lax.dynamic_slice_in_dim(all_grngs, gidx * g_loc, g_loc)
+        grngs = shard_key_slice(rng, g_total, gidx, g_loc)
 
         def group_train(gv, xg, yg, cg, grng, pg):
             # pg: this group's [c_loc] participation row (unused — and
@@ -107,8 +107,7 @@ def build_sharded_hierarchical_round_fn(
 
             def inner_round(gv, r_rng):
                 # same client-key table: split(r_rng, C)[c]
-                all_crngs = jax.random.split(r_rng, c_total)
-                crngs = jax.lax.dynamic_slice_in_dim(all_crngs, cidx * c_loc, c_loc)
+                crngs = shard_key_slice(r_rng, c_total, cidx, c_loc)
                 result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
                     gv, xg, yg, cg, crngs
                 )

@@ -26,9 +26,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.algorithms.aggregators import quarantine_stage
 from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
+from fedml_tpu.core.builder import masked_psum_tail, shard_key_slice
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import shard_map
-from fedml_tpu.utils.pytree import tree_where
 
 
 def build_sharded_round_fn(
@@ -73,8 +73,7 @@ def build_sharded_round_fn(
         c_local = x.shape[0]
         didx = jax.lax.axis_index(axis)
         # same key table as the vmap engine: split(rng, C)[d*c_local:(d+1)*c_local]
-        all_keys = jax.random.split(rng, c_local * n_dev)
-        crngs = jax.lax.dynamic_slice_in_dim(all_keys, didx * c_local, c_local)
+        crngs = shard_key_slice(rng, c_local * n_dev, didx, c_local)
         result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
             global_variables, x, y, counts, crngs
         )
@@ -102,15 +101,9 @@ def build_sharded_round_fn(
             if collect_stats:
                 return new_global, new_state, metrics, stats
             return new_global, new_state, metrics
-        alive_total = jax.lax.psum(alive.sum(), axis)
-        # psum outputs are invariant-typed, so the no-op guard's select is
-        # invariant too and check_vma accepts the P() out_specs unchanged
-        any_alive = alive_total > 0
-        new_global = tree_where(any_alive, new_global, global_variables)
-        new_state = tree_where(any_alive, new_state, agg_state)
-        metrics["participated_count"] = alive_total.astype(jnp.float32)
-        metrics["quarantined_count"] = jax.lax.psum(
-            quarantined.sum(), axis).astype(jnp.float32)
+        new_global, new_state, metrics = masked_psum_tail(
+            new_global, new_state, metrics, alive, quarantined,
+            global_variables, agg_state, axis)
         if collect_stats:
             return new_global, new_state, metrics, stats
         return new_global, new_state, metrics
@@ -252,13 +245,9 @@ def build_sharded_buffer_fns(
             global_variables, result, weights, rng, agg_state, axis)
         metrics = {k: jax.lax.psum(v.sum(), axis)
                    for k, v in result.metrics.items()}
-        alive_total = jax.lax.psum(alive.sum(), axis)
-        any_alive = alive_total > 0
-        new_global = tree_where(any_alive, new_global, global_variables)
-        new_state = tree_where(any_alive, new_state, agg_state)
-        metrics["participated_count"] = alive_total.astype(jnp.float32)
-        metrics["quarantined_count"] = jax.lax.psum(
-            quarantined.sum(), axis).astype(jnp.float32)
+        new_global, new_state, metrics = masked_psum_tail(
+            new_global, new_state, metrics, alive, quarantined,
+            global_variables, agg_state, axis)
         alive_f = alive.astype(jnp.float32)
         metrics["staleness_sum"] = jax.lax.psum(
             jnp.sum(staleness * alive_f), axis)

@@ -53,9 +53,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from fedml_tpu.analysis.partition import _flat_paths, match_partition_rules
+from fedml_tpu.core.builder import (build_round_core, donation_argnums,
+                                    masked_psum_tail, shard_key_slice)
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.utils.jax_compat import shard_map
-from fedml_tpu.utils.pytree import tree_where
 
 CLIENT_AXIS = "clients"
 TENSOR_AXIS = "tensor"
@@ -457,9 +458,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             didx = jax.lax.axis_index(CLIENT_AXIS)
             # same key table as the vmap engine / 1-D sharded round:
             # split(rng, C)[d*c_local:(d+1)*c_local]
-            all_keys = jax.random.split(rng, c_local * n_cl)
-            crngs = jax.lax.dynamic_slice_in_dim(all_keys, didx * c_local,
-                                                 c_local)
+            crngs = shard_key_slice(rng, c_local * n_cl, didx, c_local)
             gv_full = _gather_tree(gv_shard, specs_gv)
             result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
                 gv_full, x, y, counts, crngs)
@@ -487,13 +486,9 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                 if collect_stats:
                     return new_gshard, new_st, metrics, stats
                 return new_gshard, new_st, metrics
-            alive_total = jax.lax.psum(alive.sum(), CLIENT_AXIS)
-            any_alive = alive_total > 0
-            new_gshard = tree_where(any_alive, new_gshard, gv_shard)
-            new_st = tree_where(any_alive, new_st, st_shard)
-            metrics["participated_count"] = alive_total.astype(jnp.float32)
-            metrics["quarantined_count"] = jax.lax.psum(
-                quarantined.sum(), CLIENT_AXIS).astype(jnp.float32)
+            new_gshard, new_st, metrics = masked_psum_tail(
+                new_gshard, new_st, metrics, alive, quarantined,
+                gv_shard, st_shard, CLIENT_AXIS)
             if collect_stats:
                 return new_gshard, new_st, metrics, stats
             return new_gshard, new_st, metrics
@@ -510,9 +505,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             resid = st_shard["codec"]
             c_local = x.shape[0]
             didx = jax.lax.axis_index(CLIENT_AXIS)
-            all_keys = jax.random.split(rng, c_local * n_cl)
-            crngs = jax.lax.dynamic_slice_in_dim(all_keys, didx * c_local,
-                                                 c_local)
+            crngs = shard_key_slice(rng, c_local * n_cl, didx, c_local)
             gv_full = _quantized_gather_tree(gv_shard, specs_gv, t_sz,
                                              down_levels)
             result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
@@ -561,15 +554,9 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
                 if collect_stats:
                     return new_gshard, new_st, metrics, stats
                 return new_gshard, new_st, metrics
-            alive_total = jax.lax.psum(alive.sum(), CLIENT_AXIS)
-            any_alive = alive_total > 0
-            new_gshard = tree_where(any_alive, new_gshard, gv_shard)
-            # the all-dead revert covers the residual carry too: a round
-            # that commits nothing must not mutate the error feedback
-            new_st = tree_where(any_alive, new_st, st_shard)
-            metrics["participated_count"] = alive_total.astype(jnp.float32)
-            metrics["quarantined_count"] = jax.lax.psum(
-                quarantined.sum(), CLIENT_AXIS).astype(jnp.float32)
+            new_gshard, new_st, metrics = masked_psum_tail(
+                new_gshard, new_st, metrics, alive, quarantined,
+                gv_shard, st_shard, CLIENT_AXIS)
             if collect_stats:
                 return new_gshard, new_st, metrics, stats
             return new_gshard, new_st, metrics
@@ -584,11 +571,7 @@ def build_tensor_round_fn(trainer, cfg: FedConfig, aggregator,
             out_specs = out_specs + (PS(CLIENT_AXIS),)
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
-        donate: Tuple[int, ...] = ()
-        if donate_state:
-            donate += (0, 1)
-        if donate_data:
-            donate += (2, 3, 4)
+        donate = donation_argnums(donate_state, donate_data)
         return jax.jit(fn, donate_argnums=donate) if donate else jax.jit(fn)
 
     cache: dict = {}
@@ -744,9 +727,7 @@ def build_tensor_step_round_fn(trainer, cfg: FedConfig, aggregator,
             "codec transports are manual shard_map collectives and do not "
             "compose with it. Drop --shard_step (the storage-sharded "
             "tensor round supports codecs) or --update_codec.")
-    from fedml_tpu.algorithms.aggregators import quarantine_stage
-    from fedml_tpu.algorithms.engine import build_local_update, cohort_stats
-    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
+    from fedml_tpu.algorithms.engine import _vmapped_update
     from fedml_tpu.parallel.activations import (activation_rules_for_model,
                                                 activation_sharding)
 
@@ -754,36 +735,18 @@ def build_tensor_step_round_fn(trainer, cfg: FedConfig, aggregator,
     n_cl = mesh.shape[CLIENT_AXIS]
     t_sz = mesh.shape[TENSOR_AXIS]
     act_rules = activation_rules_for_model(cfg.model)
-    local_update = build_local_update(trainer, cfg)
+    # the round body IS the engine's round: the shared core from
+    # core/builder.py (same rng table, quarantine staging, all-dead guard,
+    # LoRA strip/attach), jitted under GSPMD instead of plain jit — the
+    # --equiv engine proves the two programs identical up to sharding
+    # annotations (the tensor-shards-1 contract)
+    core = build_round_core(_vmapped_update(trainer, cfg), aggregator,
+                            collect_stats)
 
     def round_body(global_variables, agg_state, x, y, counts, rng,
                    participation=None):
-        crngs = jax.random.split(rng, x.shape[0])
-        result = jax.vmap(local_update, in_axes=(None, 0, 0, 0, 0))(
-            global_variables, x, y, counts, crngs)
-        stats = cohort_stats(global_variables, result) if collect_stats \
-            else None
-        weights = counts.astype(jnp.float32)
-        if participation is None:
-            new_global, new_state = aggregator(
-                global_variables, result, weights, rng, agg_state)
-            new_global = attach_lora_base(new_global, global_variables)
-            metrics = {k: v.sum() for k, v in result.metrics.items()}
-            if collect_stats:
-                return new_global, new_state, metrics, stats
-            return new_global, new_state, metrics
-        result, weights, alive, quarantined = quarantine_stage(
-            result, weights, participation)
-        new_global, new_state = aggregator(
-            global_variables, result, weights, rng, agg_state)
-        any_alive = jnp.any(alive)
-        new_global = tree_where(any_alive, new_global,
-                                strip_lora_base(global_variables))
-        new_state = tree_where(any_alive, new_state, agg_state)
-        new_global = attach_lora_base(new_global, global_variables)
-        metrics = {k: v.sum() for k, v in result.metrics.items()}
-        metrics["participated_count"] = alive.sum().astype(jnp.float32)
-        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        new_global, new_state, metrics, stats = core(
+            global_variables, agg_state, x, y, counts, rng, participation)
         if collect_stats:
             return new_global, new_state, metrics, stats
         return new_global, new_state, metrics
@@ -808,11 +771,7 @@ def build_tensor_step_round_fn(trainer, cfg: FedConfig, aggregator,
             out_sh = (gv_sh, st_sh, repl_sh)
             if collect_stats:
                 out_sh = out_sh + (data_sh,)
-            donate: Tuple[int, ...] = ()
-            if donate_state:
-                donate += (0, 1)
-            if donate_data:
-                donate += (2, 3, 4)
+            donate = donation_argnums(donate_state, donate_data)
             jitted = jax.jit(round_body, in_shardings=in_sh,
                              out_shardings=out_sh, donate_argnums=donate)
             cache[key] = jitted

@@ -696,6 +696,35 @@ def test_rng_key_reuse_fold_in_derivation_is_blessed():
     assert not _rng_findings(src)
 
 
+def test_rng_key_reuse_feistel_block_rebind_is_fresh():
+    # the superstep drive idiom (algorithms/fedavg.py): each dispatch
+    # derives its key block from the host-side feistel schedule
+    # (algorithms/sampling.py) — a per-iteration rebind is a FRESH key
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(xs, rng_block):\n"
+        "    for j, x in enumerate(xs):\n"
+        "        rng_block = feistel_keys_block(j, 2)\n"
+        "        out = f(x, rng_block)\n")
+    assert not _rng_findings(src)
+
+
+def test_rng_key_reuse_fires_on_feistel_block_replay():
+    # the derived block is itself a key: feeding the SAME block to two
+    # dispatches replays identical in-graph cohort sampling
+    src = (
+        "import jax\n"
+        "f = jax.jit(g)\n"
+        "def run(x, y):\n"
+        "    rng_block = split_keys(feistel_round_keys(3))\n"
+        "    a = f(x, rng_block)\n"
+        "    b = f(y, rng_block)\n"
+        "    return a + b\n")
+    findings = _rng_findings(src)
+    assert findings and "second" in findings[0].message
+
+
 def test_rng_key_reuse_suppression_works():
     src = (
         "import jax\n"
