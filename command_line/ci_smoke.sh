@@ -594,6 +594,92 @@ assert any(l.startswith("FAIL tenant=blower") for l in lines), report
 print("OK serving compile gate trips on one request over the eager ceiling")
 EOF
 
+echo "== graft-slo overload smoke: preemption + admission on one mesh slot"
+python - <<'EOF'
+# one mesh slot (max_resident=1), bounded queue (max_queued=2, reject):
+# a latency-class arrival must preempt the running throughput tenant via
+# checkpointed eviction, a third arrival must bounce as a schema'd
+# job_rejected event, and the evicted-then-resumed tenant must finish
+# byte-identical to its uninterrupted solo run
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.serving import JobDescriptor, Scheduler
+from fedml_tpu.telemetry.tracer import Tracer
+
+ds = load_dataset("mnist", client_num_in_total=8, partition_method="homo")
+cfg = FedConfig(comm_round=3, epochs=1, batch_size=4, lr=0.05,
+                client_num_in_total=8, client_num_per_round=8)
+tracer = Tracer()
+sched = Scheduler(policy="fair_share", tracer=tracer,
+                  max_resident=1, admission="reject", max_queued=2)
+sched.submit(JobDescriptor(name="tp", config=cfg, dataset=ds))
+sched.tick()  # tp takes the slot
+sched.submit(JobDescriptor(name="lat", config=cfg.replace(seed=1,
+                                                          comm_round=1),
+                           dataset=ds, slo="latency"))
+bounced = sched.submit(JobDescriptor(name="extra",
+                                     config=cfg.replace(seed=2),
+                                     dataset=ds))
+assert bounced is None and sched.rejections == 1
+while sched.tick() is not None:
+    pass
+sched.close()
+assert sched.queue.all_done() and sched.evictions == 1
+kinds = [e["kind"] for e in tracer.find_events()
+         if e["kind"] in ("job_evicted", "job_resumed", "job_rejected")]
+assert kinds == ["job_rejected", "job_evicted", "job_resumed"], kinds
+rej = tracer.find_events("job_rejected")[0]
+assert rej["job"] == "extra" and rej["reason"] == "queue_full"
+
+solo = FedAvgAPI(ds, cfg,
+                 ClassificationTrainer(create_model("lr", output_dim=10)))
+solo.train()
+for a, b in zip(jax.tree.leaves(sched.queue.get("tp").final_params()),
+                jax.tree.leaves(jax.device_get(solo.global_variables))):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+        "evicted+resumed tenant diverged from its solo run"
+print(f"OK graft-slo overload: 1 eviction, 1 rejection, resumed tenant "
+      f"byte-identical to solo in {sched.ticks} ticks")
+EOF
+
+echo "== SLO deadline-gate self-test: a blown deadline must FAIL"
+python - <<'EOF'
+# deterministic injected clock (1s per reading) makes any completed job
+# blow a 0.5s deadline: the per-tenant deadline-miss ceiling must trip,
+# proving the SLO gate reads measured latency, not declared intent
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import itertools
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.serving import JobDescriptor, Scheduler
+from fedml_tpu.telemetry.tracer import Tracer
+
+ds = load_dataset("mnist", client_num_in_total=2, partition_method="homo")
+cfg = FedConfig(comm_round=1, epochs=1, batch_size=4,
+                client_num_in_total=2, client_num_per_round=2)
+clock = itertools.count()
+tracer = Tracer(clock=lambda: float(next(clock)))
+sched = Scheduler(tracer=tracer)
+sched.submit(JobDescriptor(name="urgent", config=cfg, dataset=ds,
+                           slo="latency", deadline_s=0.5))
+sched.run()
+assert sched.slo_ledger["urgent"]["misses"] == 1
+assert len(tracer.find_events("deadline_miss")) == 1
+ok, report = sched.check_slo(0)
+print(report)
+assert not ok, "deadline-miss ceiling failed to trip"
+assert any(l.startswith("FAIL tenant=urgent") for l in report.splitlines())
+print("OK SLO gate trips on a blown deadline (and reports it readably)")
+EOF
+
 echo "== perf-regression gate (ROADMAP item 5): TRACE rounds/s vs BENCH baseline"
 rm -f /tmp/ci_gate_trace.jsonl
 BENCH_PIPE_ROUNDS=10 BENCH_PIPE_REPS=2 BENCH_PIPE_DEPTHS=0 BENCH_PIPE_MODEL=lr \

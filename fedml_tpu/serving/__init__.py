@@ -9,5 +9,6 @@ bit-reproducible: each tenant's final params are byte-identical to running
 its job solo, no matter how the tenants interleave.
 """
 
+from fedml_tpu.serving.evict_store import EvictionStore  # noqa: F401
 from fedml_tpu.serving.job import Job, JobDescriptor  # noqa: F401
 from fedml_tpu.serving.scheduler import JobQueue, Scheduler  # noqa: F401

@@ -1,0 +1,90 @@
+"""Mmap-backed spill store for evicted tenant snapshots (graft-slo).
+
+`Job.evict()` fetches a tenant's full Checkpointable surface to host —
+params/adapters, aggregator (and codec residual) state, the buffered
+runner's K-row buffer + birth tags + pending-arrival results, the guard's
+loss history. Holding 100+ evicted tenants' snapshots as live numpy in the
+scheduler process is exactly the RSS failure mode the packed-store layout
+was built to avoid, so the store spills every array leaf of the snapshot
+into ONE packed binary per tenant (`<name>.bin`) with a JSON manifest of
+(offset, dtype, shape) entries, and `load()` hands the leaves back as
+`np.memmap` views — the OS pages them in lazily when `Job.resume()`
+re-uploads them, and a resumed tenant's bytes are identical to an
+in-memory round trip (tests/test_serving.py pins evict→resume parity
+through this store).
+
+Only array leaves go out-of-line; the snapshot's small host structure
+(arrival schedules, birth tags, counters, the pytree skeleton itself)
+stays in memory — it is O(cohort), not O(model), and the treedef cannot
+be serialized portably anyway. The store is in-process by design: eviction
+frees *device* memory (the mesh slot), not the scheduler's address space.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+class EvictionStore:
+    """One spill directory; tenants addressed by job name (re-evicting a
+    name overwrites its previous spill)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # name -> (treedef, inline leaves with None placeholders, manifest)
+        self._index: Dict[str, Tuple[Any, list, dict]] = {}
+
+    def save(self, name: str, snapshot: Any) -> dict:
+        """Spill `snapshot`'s array leaves to `<name>.bin`; returns the
+        manifest (also written as `<name>.json` for inspection)."""
+        leaves, treedef = jax.tree.flatten(snapshot)
+        bin_path = os.path.join(self.root, f"{name}.bin")
+        entries = []
+        inline = []
+        offset = 0
+        with open(bin_path, "wb") as f:
+            for i, leaf in enumerate(leaves):
+                if isinstance(leaf, np.ndarray) and leaf.size:
+                    data = np.ascontiguousarray(leaf)
+                    f.write(data.tobytes())
+                    # leaf.shape, not data.shape: ascontiguousarray
+                    # promotes 0-d scalars to 1-d
+                    entries.append({"i": i, "offset": offset,
+                                    "dtype": str(data.dtype),
+                                    "shape": list(leaf.shape)})
+                    offset += data.nbytes
+                    inline.append(None)
+                else:
+                    inline.append(leaf)
+        manifest = {"bin": bin_path, "bytes": offset, "arrays": entries}
+        with open(os.path.join(self.root, f"{name}.json"), "w") as f:
+            json.dump(manifest, f)
+        self._index[name] = (treedef, inline, manifest)
+        return manifest
+
+    def load(self, name: str) -> Any:
+        """Rehydrate `name`'s snapshot; array leaves come back as read-only
+        `np.memmap` views over the packed binary."""
+        treedef, inline, manifest = self._index.pop(name)
+        leaves = list(inline)
+        for e in manifest["arrays"]:
+            shape = tuple(e["shape"])
+            # map flat, then reshape: np.memmap cannot express 0-d shapes
+            flat = np.memmap(
+                manifest["bin"], mode="r", dtype=np.dtype(e["dtype"]),
+                shape=(int(np.prod(shape, dtype=np.int64)),),
+                offset=e["offset"])
+            leaves[e["i"]] = flat.reshape(shape)
+        return jax.tree.unflatten(treedef, leaves)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)

@@ -20,6 +20,22 @@ same step/drain code path as the classic buffered loop — optionally in
 `partial_dispatch` mode, where each dispatch round stages only as many
 replacement clients as arrivals have freed buffer capacity
 (`FedAvgAPI.stage_partial_cohort`) instead of re-running the full cohort.
+
+Overload robustness (graft-slo): `evict()` snapshots the job's FULL
+Checkpointable surface to host — params/adapters + aggregator (and codec
+residual) state via `_ckpt_tree`, the history via `_ckpt_meta`, the
+buffered runner's device buffer + birth tags + pending-arrival schedule
+via `BufferedRunner.snapshot()` (the same surface guard rollback rewinds),
+and the round guard's loss window — then drops every device reference, so
+the tenant's mesh slot is free. `resume()` rebuilds the api/runner from
+the descriptor (the persistent XLA compile cache makes the rebuild a
+warm start — traced again, compiled never) and restores the snapshot;
+an evicted-then-resumed tenant trains byte-identical final params to its
+uninterrupted solo run, for sync AND buffered (straggler-armed) tenants
+(tests/test_serving.py). Snapshots optionally spill to the mmap-backed
+`serving.evict_store.EvictionStore` so parked tenants cost file pages,
+not RSS. Under LoRA the snapshot is adapters-only (`_ckpt_tree` strips
+the deterministic frozen base), so eviction is O(adapter bytes).
 """
 
 from __future__ import annotations
@@ -36,6 +52,11 @@ from fedml_tpu.core.config import FedConfig
 from fedml_tpu.robustness.chaos import summarize as chaos_summary
 from fedml_tpu.telemetry.records import RoundRecordLog
 
+#: SLO classes a tenant may declare: latency-bound tenants form a strict
+#: priority tier in the scheduler's pick and may preempt throughput-bound
+#: residents via evict(); throughput-bound tenants absorb the slack.
+SLO_CLASSES = ("throughput", "latency")
+
 
 @dataclass(frozen=True)
 class JobDescriptor:
@@ -45,6 +66,13 @@ class JobDescriptor:
     `partial_dispatch` opts a buffered job into replacement-client
     dispatch. `trainer_factory` defaults to the standard classification
     trainer over `create_model(cfg.model, output_dim=dataset.class_num)`.
+
+    graft-slo fields: `slo` declares the tenant's class (see SLO_CLASSES);
+    `deadline_s` arms the scheduler's per-tenant deadline-miss ledger
+    (completion - submission > deadline_s -> a `deadline_miss` event —
+    measured telemetry, never a pick input); `guard` attaches a round
+    guard (robustness.guard.RoundGuard) to the served job, mirroring the
+    solo drive's rollback-and-retry semantics exactly.
     """
 
     name: str
@@ -55,7 +83,15 @@ class JobDescriptor:
     chaos: Any = None  # robustness.chaos.FaultPlan
     weight: float = 1.0
     partial_dispatch: bool = False
+    slo: str = "throughput"
+    deadline_s: Optional[float] = None
+    guard: Any = None  # robustness.guard.RoundGuard
     extra: dict = field(default_factory=dict, hash=False, compare=False)
+
+    def __post_init__(self):
+        if self.slo not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown slo class {self.slo!r}; choose from {SLO_CLASSES}")
 
     @property
     def kind(self) -> str:
@@ -112,42 +148,78 @@ class JobDescriptor:
 
 
 class Job:
-    """One tenant's runtime: pending -> running -> committed.
+    """One tenant's runtime: (queued ->) pending -> running -> committed,
+    with evicted as a parkable detour and cancelled as the other terminal.
 
     `step(tracer)` executes exactly one dispatch round (buffered jobs also
     drain after their final round) and returns True once the job has
     consumed its whole round budget. The scheduler owns WHEN steps happen;
     the job owns WHAT a step does — and what it does is independent of the
-    interleaving by construction (see module docstring)."""
+    interleaving by construction (see module docstring).
 
-    def __init__(self, desc: JobDescriptor):
+    `build=False` defers `desc.build_api()` until `materialize()` — the
+    admission-controlled scheduler admits hundreds of tenants without
+    paying device state for any that never reach the mesh."""
+
+    def __init__(self, desc: JobDescriptor, build: bool = True):
         self.desc = desc
         self.name = desc.name
-        self.api = desc.build_api()
-        self.round_idx = 0
-        self.state = "pending"
-        self.records: Optional[RoundRecordLog] = None
+        self.api: Optional[FedAvgAPI] = None
         self.runner: Optional[BufferedRunner] = None
-        if desc.kind == "buffered":
-            self.runner = BufferedRunner(
-                self.api, chaos=desc.chaos,
-                partial_dispatch=desc.partial_dispatch)
+        self.records: Optional[RoundRecordLog] = None
+        self.round_idx = 0
+        self.state = "queued"
+        # eviction snapshot (host pytree, or an EvictionStore holding it)
+        self._snapshot = None
+        self._spill_store = None
         # scheduler bookkeeping (deficit-weighted fair share + bench timing)
         self.deficit = 0.0
         self.dispatched_ticks = 0
         self.submit_t: Optional[float] = None
         self.start_t: Optional[float] = None
         self.finish_t: Optional[float] = None
+        self._submit_seq = 0  # scheduler-stamped submission index
+        self.warm_start = False  # scheduler warm-pool signature hit
         # one-shot staged-cohort handoff from the scheduler's shared
         # prefetcher into the api's stage seam (sync path)
         self._staged_override = None
+        if build:
+            self.materialize()
+
+    def materialize(self) -> None:
+        """Build (or rebuild, on resume) the device-facing runtime: the
+        FedAvgAPI, the buffered runner, and the stage-override seam.
+        Idempotent while an api is live."""
+        if self.api is not None:
+            return
+        self.api = self.desc.build_api()
+        if self.desc.kind == "buffered":
+            # the guard rides into the runner so donation gating matches
+            # the solo buffered drive (a guard snapshot holds the buffer's
+            # arrays — donating them would deallocate the snapshot)
+            self.runner = BufferedRunner(
+                self.api, chaos=self.desc.chaos, guard=self.desc.guard,
+                partial_dispatch=self.desc.partial_dispatch)
         self._orig_stage_fn = self.api.stage_fn
         self.api.stage_fn = self._stage_or_override
+        if self.state == "queued":
+            self.state = "pending"
 
     # ------------------------------------------------------------- plumbing
     @property
     def done(self) -> bool:
         return self.state == "committed"
+
+    @property
+    def closed(self) -> bool:
+        """Terminal either way: committed or cancelled — the job will
+        never be scheduled again."""
+        return self.state in ("committed", "cancelled")
+
+    @property
+    def resident(self) -> bool:
+        """Whether this job currently holds device state (a mesh slot)."""
+        return self.api is not None
 
     @property
     def history(self):
@@ -173,13 +245,112 @@ class Job:
         callback (pure in round_idx; chaos faults derived per round)."""
         return self._orig_stage_fn(round_idx, chaos=self.desc.chaos)
 
+    # ------------------------------------------------------ evict / resume
+    def evict(self, tracer, reason: str = "preempted", store=None) -> bool:
+        """Checkpointed preemption: fetch the job's full state surface to
+        host, drop every device reference (the mesh slot is free), park
+        the snapshot (optionally spilled into `store`, an EvictionStore).
+        Only called at step boundaries, where the record log is flushed
+        and no staged cohort is in flight. Returns False when there is
+        nothing resident to evict."""
+        if self.api is None or self.closed:
+            return False
+        if self.records is not None:
+            self.records.flush(self.round_idx)
+        buf = None
+        host_snap = None
+        in_flight = 0
+        if self.runner is not None:
+            if self.api._buffer is not None:
+                buf = jax.device_get(self.api._buffer)
+            # the pending dict holds the client-step programs' stacked
+            # device results — device_get folds them (and nothing else;
+            # host ints/lists pass through) into plain numpy
+            host_snap = jax.device_get(self.runner.host.snapshot())
+            in_flight = self.runner.in_flight
+        guard = self.desc.guard
+        snap = {
+            "tree": jax.device_get(self.api._ckpt_tree()),
+            "meta": self.api._ckpt_meta(),
+            "buffer": buf,
+            "host": host_snap,
+            "in_flight": in_flight,
+            "round_idx": self.round_idx,
+            "state": self.state,
+            "guard_losses": (list(guard._losses)
+                             if guard is not None else None),
+        }
+        if store is not None:
+            store.save(self.name, snap)
+            self._snapshot = None
+            self._spill_store = store
+        else:
+            self._snapshot = snap
+            self._spill_store = None
+        # free the mesh slot: every device reference goes
+        self.api = None
+        self.runner = None
+        self.records = None
+        self._staged_override = None
+        self.state = "evicted"
+        tracer.event("job_evicted", job=self.name, round=self.round_idx,
+                     reason=reason)
+        return True
+
+    def resume(self, tracer) -> bool:
+        """Rebuild the runtime from the descriptor and restore the parked
+        snapshot. The rebuild re-traces the same programs a fresh build
+        would — with the persistent compile cache enabled XLA serves them
+        warm (cache_hits > 0, no new compiles: tests/test_serving.py) —
+        and the restored bytes make the resumed run a bitwise continuation
+        of the evicted one."""
+        if self.state != "evicted":
+            return False
+        snap = (self._spill_store.load(self.name)
+                if self._spill_store is not None else self._snapshot)
+        self._snapshot = None
+        self._spill_store = None
+        self.materialize()
+        api = self.api
+        api._ckpt_load(snap["tree"], snap["meta"])
+        if self.runner is not None:
+            if snap["buffer"] is not None:
+                api._buffer = jax.device_put(snap["buffer"])
+            self.runner.host.restore(snap["host"])
+            self.runner.in_flight = snap["in_flight"]
+        guard = self.desc.guard
+        if guard is not None and snap["guard_losses"] is not None:
+            guard._losses.clear()
+            guard._losses.extend(snap["guard_losses"])
+        self.round_idx = snap["round_idx"]
+        self.state = snap["state"]
+        if self.state == "running":
+            # _ckpt_load restored the history INTO api.history in place;
+            # the fresh record log binds to that same list
+            self.records = RoundRecordLog(tracer, api.history, None)
+        tracer.event("job_resumed", job=self.name, round=self.round_idx)
+        return True
+
+    def cancel(self) -> None:
+        """Terminal removal (admission shed / caller cancel): device refs
+        and any parked snapshot are dropped; the job never runs again."""
+        self.api = None
+        self.runner = None
+        self.records = None
+        self._snapshot = None
+        self._spill_store = None
+        self._staged_override = None
+        self.state = "cancelled"
+
     # ----------------------------------------------------------------- step
     def step(self, tracer, staged=None) -> bool:
         """One schedulable unit of this job. `staged` (optional) is a
         prefetched cohort for `self.round_idx`. Returns True when the job
         just finished (drain included)."""
-        if self.done:
+        if self.closed:
             return True
+        if self.api is None:
+            self.materialize()
         if self.state == "pending":
             self.state = "running"
             self.records = RoundRecordLog(tracer, self.api.history, None)
@@ -192,74 +363,142 @@ class Job:
         return self.done
 
     def _step_sync(self, tracer, staged) -> None:
+        """One sync round — guard retry attempts included, mirroring
+        `FedAvgAPI._eager_round` exactly (snapshot refs, salted rng,
+        verdict/rollback/exhausted events), so a guard-armed served tenant
+        stays byte-identical to its solo run."""
         cfg = self.api.cfg
+        guard = self.desc.guard
         r = self.round_idx
-        with tracer.round(r) as rspan:
-            faults = None
-            if self.desc.chaos is not None and staged is None:
-                n_cohort = min(cfg.client_num_per_round,
-                               self.api.dataset.client_num)
-                faults = self.desc.chaos.events(r, n_cohort)
-            self._staged_override = staged
-            train_metrics = self.api.train_one_round(r, faults=faults,
-                                                     tracer=tracer)
-            with tracer.span("device_wait", r):
-                jax.block_until_ready(self.api.global_variables)
-            record = {"round": r, "round_time": rspan.elapsed()}
-            staged_used, stats = self.api._last_dispatch
-            block = FedAvgAPI._ledger_block(r, staged_used, stats)
-            if block is not None:
-                record["_ledger"] = [block]
-            if staged_used.faults is not None:
-                record.update(chaos_summary(staged_used.faults))
-                for k in ("participated_count", "quarantined_count"):
-                    if k in train_metrics:
-                        record[k] = train_metrics[k]
-            if (r % cfg.frequency_of_the_test == 0
-                    or r == cfg.comm_round - 1):
-                with tracer.span("eval", r):
-                    record.update(self.api.local_test_on_all_clients(r))
-                    record.update(self.api.test_global(r))
-            self.records.add(record)
-            self.records.flush(r)
+        retries = 0
+        while True:
+            rejected = False
+            with tracer.round(r) as rspan:
+                faults = None
+                if self.desc.chaos is not None and staged is None:
+                    n_cohort = min(cfg.client_num_per_round,
+                                   self.api.dataset.client_num)
+                    faults = self.desc.chaos.events(r, n_cohort)
+                snapshot = None
+                if guard is not None:
+                    # jax pytrees are immutable: the refs ARE the snapshot
+                    snapshot = (self.api._ckpt_tree(), self.api._ckpt_meta())
+                self._staged_override = staged
+                train_metrics = self.api.train_one_round(r, faults=faults,
+                                                         rng_salt=retries,
+                                                         tracer=tracer)
+                with tracer.span("device_wait", r):
+                    jax.block_until_ready(self.api.global_variables)
+                if guard is not None:
+                    total = max(train_metrics.get("total", 1.0), 1.0)
+                    loss = train_metrics.get("loss_sum", 0.0) / total
+                    with tracer.span("guard_verdict", r):
+                        verdict = guard.inspect(r, loss,
+                                                self.api.global_variables)
+                    tracer.event("guard_verdict", round=r, ok=verdict.ok,
+                                 reason=verdict.reason)
+                    if not verdict.ok and retries < guard.max_retries:
+                        retries += 1
+                        tracer.event("guard_rollback", round=r,
+                                     retry=retries)
+                        self.api._ckpt_load(*snapshot)
+                        rejected = True  # new attempt, new round span
+                    elif not verdict.ok:
+                        tracer.event("guard_exhausted", round=r)
+                if not rejected:
+                    record = {"round": r, "round_time": rspan.elapsed()}
+                    staged_used, stats = self.api._last_dispatch
+                    block = FedAvgAPI._ledger_block(r, staged_used, stats)
+                    if block is not None:
+                        record["_ledger"] = [block]
+                    if staged_used.faults is not None:
+                        record.update(chaos_summary(staged_used.faults))
+                        for k in ("participated_count", "quarantined_count"):
+                            if k in train_metrics:
+                                record[k] = train_metrics[k]
+                    if guard is not None and retries:
+                        record["guard_retries"] = retries
+                    if (r % cfg.frequency_of_the_test == 0
+                            or r == cfg.comm_round - 1):
+                        with tracer.span("eval", r):
+                            record.update(
+                                self.api.local_test_on_all_clients(r))
+                            record.update(self.api.test_global(r))
+                    self.records.add(record)
+                    self.records.flush(r)
+            if not rejected:
+                break
+            staged = None  # restage the retry (attempt buffers were donated)
         self.round_idx += 1
 
     def _step_buffered(self, tracer, staged) -> None:
+        """One buffered dispatch round — guard retry attempts included,
+        mirroring `train_buffered` (runner.snapshot/restore over globals +
+        buffer + arrival schedule, salted rng, restage on retry)."""
         cfg = self.api.cfg
         runner = self.runner
         host = runner.host
+        guard = self.desc.guard
         r = self.round_idx
-        with tracer.round(r) as rspan:
-            if staged is None:
-                staged = self._stage_buffered(r, tracer)
-            rng_round = runner.base_rng(r)
-            out = runner.step(r, staged, rng_round, tracer)
-            train_metrics: dict = {}
-            if out["commit_metrics"]:
-                with tracer.span("metrics_fetch", r):
-                    for m in jax.device_get(out["commit_metrics"]):
-                        for key in m:
-                            train_metrics[key] = (
-                                train_metrics.get(key, 0.0) + float(m[key]))
-            record = {"round": r, "round_time": rspan.elapsed(),
-                      "buffer_commits": out["n_commits"],
-                      "committed_updates": host.committed_updates,
-                      "buffer_fill": host.fill,
-                      "_ledger": out["ledger_blocks"]}
-            for key in ("loss_sum", "total", "participated_count",
-                        "quarantined_count", "staleness_sum",
-                        "staleness_max"):
-                if key in train_metrics:
-                    record[key] = train_metrics[key]
-            if staged is not None and staged.faults is not None:
-                record.update(chaos_summary(staged.faults))
-            if (r % cfg.frequency_of_the_test == 0
-                    or r == cfg.comm_round - 1):
-                with tracer.span("eval", r):
-                    record.update(self.api.local_test_on_all_clients(r))
-                    record.update(self.api.test_global(r))
-            self.records.add(record)
-            self.records.flush(r)
+        retries = 0
+        while True:
+            rejected = False
+            with tracer.round(r) as rspan:
+                if staged is None:
+                    staged = self._stage_buffered(r, tracer)
+                snapshot = runner.snapshot() if guard is not None else None
+                rng_round = runner.base_rng(r, retries)
+                out = runner.step(r, staged, rng_round, tracer)
+                train_metrics: dict = {}
+                if out["commit_metrics"]:
+                    with tracer.span("metrics_fetch", r):
+                        for m in jax.device_get(out["commit_metrics"]):
+                            for key in m:
+                                train_metrics[key] = (
+                                    train_metrics.get(key, 0.0)
+                                    + float(m[key]))
+                if guard is not None and out["commit_metrics"]:
+                    total = max(train_metrics.get("total", 1.0), 1.0)
+                    loss = train_metrics.get("loss_sum", 0.0) / total
+                    with tracer.span("guard_verdict", r):
+                        verdict = guard.inspect(r, loss,
+                                                self.api.global_variables)
+                    tracer.event("guard_verdict", round=r, ok=verdict.ok,
+                                 reason=verdict.reason)
+                    if not verdict.ok and retries < guard.max_retries:
+                        retries += 1
+                        tracer.event("guard_rollback", round=r,
+                                     retry=retries)
+                        runner.restore(snapshot)
+                        rejected = True
+                    elif not verdict.ok:
+                        tracer.event("guard_exhausted", round=r)
+                if not rejected:
+                    record = {"round": r, "round_time": rspan.elapsed(),
+                              "buffer_commits": out["n_commits"],
+                              "committed_updates": host.committed_updates,
+                              "buffer_fill": host.fill,
+                              "_ledger": out["ledger_blocks"]}
+                    for key in ("loss_sum", "total", "participated_count",
+                                "quarantined_count", "staleness_sum",
+                                "staleness_max"):
+                        if key in train_metrics:
+                            record[key] = train_metrics[key]
+                    if staged is not None and staged.faults is not None:
+                        record.update(chaos_summary(staged.faults))
+                    if guard is not None and retries:
+                        record["guard_retries"] = retries
+                    if (r % cfg.frequency_of_the_test == 0
+                            or r == cfg.comm_round - 1):
+                        with tracer.span("eval", r):
+                            record.update(
+                                self.api.local_test_on_all_clients(r))
+                            record.update(self.api.test_global(r))
+                    self.records.add(record)
+                    self.records.flush(r)
+            if not rejected:
+                break
+            staged = None  # restage the retry against the restored timeline
         self.round_idx += 1
         if self.round_idx >= cfg.comm_round:
             self._drain_buffered(tracer)

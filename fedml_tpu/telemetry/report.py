@@ -161,6 +161,9 @@ def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "coverage": round(coverage(records), 4),
         "phases": {name: _pcts(durs) for name, durs in sorted(by_name.items())},
         "events": dict(sorted(event_counts.items())),
+        # graft-slo: deadline misses surfaced as a first-class counter so
+        # an overload run's SLO health is readable without grepping events
+        "deadline_misses": event_counts.get("deadline_miss", 0),
         # lenient-load accounting: >0 means the trace lost its tail
         # (load_trace skipped that many unparseable lines)
         "truncated_lines": sum(r.get("count", 0) for r in records

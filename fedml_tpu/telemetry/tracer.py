@@ -87,6 +87,18 @@ EVENT_SCHEMAS: Dict[str, set] = {
     # serving plane (serving/scheduler.py): a tenant job ran its full round
     # budget (drain included) and left the queue
     "job_committed": {"job", "rounds", "wall_s"},
+    # overload robustness (graft-slo): checkpointed preemption — a tenant
+    # was snapshotted off the mesh (`round` = its next round when it
+    # resumes) and later restored byte-identically
+    "job_evicted": {"job", "round", "reason"},
+    "job_resumed": {"job", "round"},
+    # admission control: a submission bounced (reason "queue_full"), a
+    # queued tenant was shed for a latency-bound arrival (reason "shed"),
+    # or a caller cancelled it (reason "cancelled")
+    "job_rejected": {"job", "reason", "slo"},
+    # SLO ledger: a tenant finished past its declared deadline_s (measured
+    # telemetry only — never a scheduling input, so picks stay replayable)
+    "deadline_miss": {"job", "deadline_s", "latency_s"},
 }
 
 

@@ -1,5 +1,8 @@
 """graft-serve (ISSUE 12 tentpole): the multi-tenant job scheduler over one
-device mesh.
+device mesh. graft-slo (ISSUE 19) adds the overload pins at the bottom:
+evict/resume bitwise parity vs solo (sync AND buffered), deterministic
+SLO preemption, admission-policy rejection semantics, guard-rollback
+chaos composition, and warm-start resume through the compile cache.
 
 The pins that matter:
   - a two-tenant scheduler run is byte-identical across reruns (schedule
@@ -60,15 +63,17 @@ def _cfg(ds, **kw):
                      client_num_in_total=ds.client_num, **kw)
 
 
-def _desc(name, ds, weight=1.0, chaos=None, partial=False, **cfg_kw):
+def _desc(name, ds, weight=1.0, chaos=None, partial=False, slo="throughput",
+          deadline_s=None, guard=None, **cfg_kw):
     return JobDescriptor(name=name, config=_cfg(ds, **cfg_kw), dataset=ds,
-                         weight=weight, chaos=chaos, partial_dispatch=partial)
+                         weight=weight, chaos=chaos, partial_dispatch=partial,
+                         slo=slo, deadline_s=deadline_s, guard=guard)
 
 
-def _solo(ds, cfg):
+def _solo(ds, cfg, chaos=None, guard=None):
     api = FedAvgAPI(ds, cfg, ClassificationTrainer(
         create_model("lr", output_dim=ds.class_num)))
-    api.train()
+    api.train(chaos=chaos, guard=guard)
     return api
 
 
@@ -321,6 +326,317 @@ def test_compile_budget_gate_trips_on_cache_blower(ds8):
     sched.compile_ledger["blower"]["requests"] = 4
     ok2, _ = sched.check_compile_budgets(budgets)
     assert ok2
+
+
+# ------------------------------------------- graft-slo: evict / resume
+
+def _drain(sched):
+    order = []
+    while True:
+        name = sched.tick()
+        if name is None:
+            break
+        order.append(name)
+    return order
+
+
+def test_evict_resume_sync_tenant_bitwise_parity(ds8):
+    """The tentpole pin, sync half: a tenant evicted mid-run and resumed
+    trains byte-identical final params (and the same history) as its
+    uninterrupted solo run."""
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer)
+    sched.submit(_desc("t", ds8, seed=0, comm_round=2))
+    sched.tick()  # round 0 done; evict at the step boundary
+    job = sched.queue.get("t")
+    assert job.evict(tracer, reason="test")
+    assert job.state == "evicted" and not job.resident
+    assert not job.evict(tracer)  # nothing resident: idempotent no-op
+    assert job.resume(tracer) and job.resident
+    _drain(sched)
+    sched.close()
+    solo = _solo(ds8, _cfg(ds8, seed=0, comm_round=2))
+    assert params_equal(job.final_params(),
+                        jax.device_get(solo.global_variables))
+    assert ([r["round"] for r in job.history]
+            == [r["round"] for r in solo.history])
+    marks = [(e["kind"], e["job"], e["round"]) for e in tracer.find_events()
+             if e["kind"] in ("job_evicted", "job_resumed")]
+    assert marks == [("job_evicted", "t", 1), ("job_resumed", "t", 1)]
+
+
+def test_evict_resume_buffered_straggler_tenant_bitwise_parity(
+        ds16, tmp_path):
+    """The tentpole pin, buffered half: eviction snapshots the device
+    buffer + birth tags + pending straggler arrivals (spilled through the
+    mmap EvictionStore here), and the resumed tenant is byte-identical to
+    its solo buffered run."""
+    from fedml_tpu.serving import EvictionStore
+
+    plan = FaultPlan(seed=3, straggler_rate=0.5, straggler_rounds=3)
+    store = EvictionStore(str(tmp_path / "spill"))
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer)
+    sched.submit(_desc("b", ds16, seed=0, comm_round=4, buffer_size=5,
+                       staleness_alpha=0.5, client_num_per_round=8,
+                       chaos=plan))
+    sched.tick()
+    sched.tick()  # straggler updates now in flight across the eviction
+    job = sched.queue.get("b")
+    assert job.runner.host.arrivals or job.runner.host.pending
+    assert job.evict(tracer, store=store)
+    assert "b" in store and not job.resident
+    assert job.resume(tracer)
+    _drain(sched)
+    sched.close()
+    cfg = _cfg(ds16, seed=0, comm_round=4, buffer_size=5,
+               staleness_alpha=0.5, client_num_per_round=8)
+    solo = _solo(ds16, cfg, chaos=plan)
+    assert params_equal(job.final_params(),
+                        jax.device_get(solo.global_variables))
+    assert len(job.history) == len(solo.history)
+
+
+def test_scheduler_close_evicts_in_flight_jobs(ds8):
+    """Satellite 3: close() must not abandon device buffers — an
+    interrupted run's resident tenants are evicted (snapshot + free), and
+    the parked job can resume and finish afterwards."""
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer)
+    sched.submit(_desc("t", ds8, seed=0, comm_round=2))
+    sched.tick()
+    sched.close()
+    job = sched.queue.get("t")
+    assert job.state == "evicted" and not job.resident
+    evs = tracer.find_events("job_evicted")
+    assert len(evs) == 1 and evs[0]["reason"] == "close"
+    assert job.resume(tracer)
+    while not job.step(tracer):
+        pass
+    assert job.done
+    assert all(bool(np.isfinite(np.asarray(l)).all())
+               for l in jax.tree.leaves(job.final_params()))
+
+
+# ----------------------------------------- graft-slo: SLO-tier preemption
+
+@pytest.mark.slow  # ci_smoke pins the per-commit preemption + parity
+# smoke on one mesh slot; the double-run replay rides the nightly
+def test_latency_tenant_preempts_and_replays_deterministically(ds8):
+    """SLO classes on the scheduler: a latency-bound arrival preempts the
+    resident throughput-bound tenant (checkpointed eviction, max_resident
+    slot bound), runs to completion first, and the whole overload
+    schedule — dispatch order, eviction decisions, event ledger — replays
+    bit-identically; both tenants stay byte-equal to solo."""
+    def run():
+        tracer = Tracer()
+        sched = Scheduler(policy="fair_share", tracer=tracer,
+                          max_resident=1, seed=7)
+        sched.submit(_desc("tp", ds8, seed=0, comm_round=4))
+        order = [sched.tick(), sched.tick()]
+        sched.submit(_desc("lat", ds8, seed=1, comm_round=2, slo="latency"))
+        order += _drain(sched)
+        sched.close()
+        evs = [(e["kind"], e.get("job"), e.get("round"), e.get("rounds"),
+                e.get("reason"))
+               for e in tracer.find_events()
+               if e["kind"] in ("job_evicted", "job_resumed",
+                                "job_committed")]
+        return sched, order, evs
+
+    s1, order1, evs1 = run()
+    s2, order2, evs2 = run()
+    assert order1 == order2 and evs1 == evs2  # bit-identical replay
+    # the latency tenant takes the mesh the moment it arrives...
+    assert order1 == ["tp", "tp", "lat", "lat", "tp", "tp"]
+    kinds = [e[0] for e in evs1]
+    assert kinds == ["job_evicted", "job_committed", "job_resumed",
+                     "job_committed"]
+    assert evs1[0][1] == "tp" and evs1[0][4] == "preempted"
+    assert s1.evictions == 1
+    # ...and nobody's bytes moved: both tenants equal their solo runs
+    solo_tp = _solo(ds8, _cfg(ds8, seed=0, comm_round=4))
+    solo_lat = _solo(ds8, _cfg(ds8, seed=1, comm_round=2))
+    assert params_equal(s1.queue.get("tp").final_params(),
+                        jax.device_get(solo_tp.global_variables))
+    assert params_equal(s1.queue.get("lat").final_params(),
+                        jax.device_get(solo_lat.global_variables))
+
+
+# ------------------------------------- graft-slo: admission + backpressure
+
+def test_admission_reject_bounces_past_queue_bound(ds8):
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer, admission="reject", max_queued=1,
+                      max_resident=1)
+    assert sched.submit(_desc("a", ds8, seed=0, comm_round=1)) is not None
+    assert sched.submit(_desc("b", ds8, seed=1, comm_round=1)) is None
+    evs = tracer.find_events("job_rejected")
+    assert len(evs) == 1 and evs[0]["job"] == "b"
+    assert evs[0]["reason"] == "queue_full"
+    assert sched.rejections == 1
+    _drain(sched)
+    sched.close()
+    assert sched.queue.get("a").done
+    with pytest.raises(KeyError):
+        sched.queue.get("b")  # never entered the queue
+
+
+def test_admission_shed_sacrifices_queued_throughput_for_latency(ds8):
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer, admission="shed", max_queued=1,
+                      max_resident=1)
+    sched.submit(_desc("tp", ds8, seed=0, comm_round=1))
+    # a latency arrival sheds the youngest never-dispatched throughput job
+    assert sched.submit(
+        _desc("lat", ds8, seed=1, comm_round=1, slo="latency")) is not None
+    assert sched.queue.get("tp").state == "cancelled"
+    shed = [e for e in tracer.find_events("job_rejected")
+            if e["reason"] == "shed"]
+    assert len(shed) == 1 and shed[0]["job"] == "tp"
+    # no throughput victim left: the next latency arrival bounces
+    assert sched.submit(
+        _desc("lat2", ds8, seed=2, comm_round=1, slo="latency")) is None
+    _drain(sched)
+    sched.close()
+    assert sched.queue.get("lat").done and sched.queue.all_done()
+
+
+def test_cancel_removes_queued_job_with_deficit_cleanup(ds8):
+    sched = Scheduler(tracer=Tracer(), policy="fair_share", max_resident=1)
+    sched.submit(_desc("a", ds8, seed=0, comm_round=2))
+    sched.submit(_desc("c", ds8, seed=1, comm_round=2))
+    assert sched.cancel("c")
+    assert not sched.cancel("c")  # already terminal
+    assert sched.queue.get("c").state == "cancelled"
+    order = _drain(sched)
+    sched.close()
+    assert order == ["a", "a"]  # the cancelled job never runs
+    assert sched.queue.all_done()
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError, match="admission"):
+        Scheduler(admission="coinflip")
+    with pytest.raises(ValueError, match="max_resident"):
+        Scheduler(max_resident=0)
+    with pytest.raises(ValueError, match="slo"):
+        JobDescriptor(name="x", config=FedConfig(dataset="d", model="lr"),
+                      dataset=None, slo="gold")
+
+
+# ------------------------------- graft-slo: deadline ledger + chaos + warm
+
+def test_deadline_miss_ledger_and_slo_gate(ds8):
+    """Deadline misses are measured telemetry (injected deterministic
+    clock), counted per tenant in the ledger, and gated by check_slo the
+    way compile budgets are."""
+    ticks = iter(range(10 ** 9))
+    tracer = Tracer(clock=lambda: float(next(ticks)))
+    sched = Scheduler(tracer=tracer, policy="fair_share")
+    sched.submit(_desc("d", ds8, seed=0, comm_round=1, slo="latency",
+                       deadline_s=0.5))
+    sched.submit(_desc("free", ds8, seed=1, comm_round=1))
+    sched.run()
+    assert sched.slo_ledger["d"]["misses"] == 1
+    evs = tracer.find_events("deadline_miss")
+    assert len(evs) == 1 and evs[0]["job"] == "d"
+    assert evs[0]["latency_s"] > evs[0]["deadline_s"]
+    ok, report = sched.check_slo(0)
+    assert not ok
+    lines = report.splitlines()
+    assert any(ln.startswith("FAIL tenant=d") for ln in lines)
+    assert any(ln.startswith("SKIP tenant=free") for ln in lines)
+    ok2, _ = sched.check_slo(5)
+    assert ok2
+    # queue_depth / evicted-gauge telemetry rode the same run
+    assert tracer.gauge_summary()["queue_depth"]["count"] > 0
+
+
+class _TripGuard:
+    """Rejects its first inspection (forcing one rollback+retry), then
+    behaves like an always-accepting guard with a loss window — the same
+    decision sequence whether driven solo or served."""
+
+    max_retries = 2
+
+    def __init__(self):
+        from collections import deque
+
+        self._losses = deque(maxlen=8)
+        self._tripped = False
+
+    def inspect(self, round_idx, loss, global_variables=None):
+        from fedml_tpu.robustness.guard import GuardVerdict
+
+        if not self._tripped:
+            self._tripped = True
+            return GuardVerdict(False, "forced trip")
+        self._losses.append(float(loss))
+        return GuardVerdict(True, "")
+
+    def reset(self):
+        self._losses.clear()
+
+
+def test_eviction_composes_with_guard_rollback_chaos(ds16):
+    """Chaos composition: a buffered straggler tenant whose guard forced a
+    rollback is evicted right after the rollback round and resumed — the
+    guard's loss window rides the snapshot, and the final params still
+    match the solo chaos+guard run bit-for-bit."""
+    plan = FaultPlan(seed=5, straggler_rate=0.4, straggler_rounds=2)
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer)
+    sched.submit(_desc("g", ds16, seed=0, comm_round=3, buffer_size=4,
+                       staleness_alpha=0.5, client_num_per_round=8,
+                       chaos=plan, guard=_TripGuard()))
+    sched.tick()
+    sched.tick()
+    assert tracer.find_events("guard_rollback")  # the trip fired
+    job = sched.queue.get("g")
+    assert job.evict(tracer)
+    assert job.resume(tracer)
+    _drain(sched)
+    sched.close()
+    cfg = _cfg(ds16, seed=0, comm_round=3, buffer_size=4,
+               staleness_alpha=0.5, client_num_per_round=8)
+    solo = _solo(ds16, cfg, chaos=plan, guard=_TripGuard())
+    assert params_equal(job.final_params(),
+                        jax.device_get(solo.global_variables))
+
+
+def test_warm_start_resume_hits_compile_cache(
+        tmp_path, ds8, restore_jax_cache_config):
+    """Warm-start pools: a resumed tenant's rebuild re-traces but never
+    recompiles — the persistent cache serves every program (cache_hits
+    grows, cache_misses does not), and a same-signature submission is
+    flagged as a warm start."""
+    from fedml_tpu import telemetry
+
+    assert enable_compile_cache(min_compile_secs=0.0,
+                                cache_dir=str(tmp_path / "jcache"))
+    tracer = Tracer()
+    sched = Scheduler(tracer=tracer, max_resident=1)
+    sched.submit(_desc("t", ds8, seed=0, comm_round=2))
+    telemetry.install(tracer)
+    try:
+        sched.tick()  # cold build: misses land here
+        job = sched.queue.get("t")
+        pre = dict(sched.compile_ledger["t"])
+        sched._evict(job)
+        assert job.state == "evicted"
+        _drain(sched)  # resume + remaining rounds
+    finally:
+        telemetry.uninstall(tracer)
+    sched.close()
+    post = sched.compile_ledger["t"]
+    assert job.done
+    assert post["cache_hits"] > pre["cache_hits"]  # rebuild served warm
+    assert post["cache_misses"] == pre["cache_misses"]  # no new compiles
+    assert job.warm_start is False  # first of its signature
+    j2 = sched.submit(_desc("t2", ds8, seed=1, comm_round=1))
+    assert j2 is not None and j2.warm_start  # same program shape: pooled
+    sched.cancel("t2")
 
 
 def test_serving_budget_entry_matches_enumeration():

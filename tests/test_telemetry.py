@@ -112,6 +112,11 @@ _SAMPLE_EVENTS = {
     "trace_rotated": dict(rotated_to="TRACE.jsonl.000", segment=0, bytes=1024),
     "client_flagged": dict(client=17, reason="quarantine_recidivist", value=3),
     "job_committed": dict(job="tenant-a", rounds=10, wall_s=1.25),
+    "job_evicted": dict(job="tenant-a", round=3, reason="preempted"),
+    "job_resumed": dict(job="tenant-a", round=3),
+    "job_rejected": dict(job="tenant-z", reason="queue_full",
+                         slo="throughput"),
+    "deadline_miss": dict(job="tenant-a", deadline_s=2.0, latency_s=3.7),
 }
 
 
@@ -138,6 +143,32 @@ def test_event_schema_rejects_unknown_kind_and_missing_fields():
         t.event("made_up_kind", round=0)
     with pytest.raises(ValueError, match="missing required field"):
         t.event("chaos_inject", round=0, dropped=1)  # nan, corrupt missing
+    # graft-slo kinds are schema'd too: a rejection must name its reason
+    # and class, an eviction its resume round
+    with pytest.raises(ValueError, match="missing required field"):
+        t.event("job_rejected", job="t")  # reason, slo missing
+    with pytest.raises(ValueError, match="missing required field"):
+        t.event("job_evicted", job="t", reason="preempted")  # round missing
+    with pytest.raises(ValueError, match="missing required field"):
+        t.event("deadline_miss", job="t", deadline_s=1.0)  # latency_s missing
+
+
+def test_overload_gauges_round_trip(tmp_path):
+    """queue_depth / evicted_jobs gauges (scheduler overload telemetry)
+    fold through gauge_summary like any other gauge."""
+    t = Tracer(jsonl_path=str(tmp_path / "TRACE.jsonl"))
+    t.gauge("queue_depth", depth=3)
+    t.gauge("queue_depth", depth=5, rejected=1)
+    t.gauge("evicted_jobs", count=1, job="tenant-a")
+    t.close()
+    gs = t.gauge_summary()
+    assert gs["queue_depth"]["count"] == 2
+    assert gs["queue_depth"]["last"]["depth"] == 5
+    assert gs["queue_depth"]["total"]["depth"] == 8
+    assert gs["evicted_jobs"]["last"]["job"] == "tenant-a"
+    records = load_trace(str(tmp_path / "TRACE.jsonl"))
+    names = [r["name"] for r in records if r["type"] == "gauge"]
+    assert names == ["queue_depth", "queue_depth", "evicted_jobs"]
 
 
 def test_events_are_flushed_to_jsonl_before_close(tmp_path):

@@ -16,6 +16,15 @@ scheduler). The artifact's `parsed` block has NO top-level
 multi-tenant jobs/s number must never be compared against the single-drive
 rounds/s baselines.
 
+Overload mode (graft-slo): every k-th tenant is latency-bound with a
+deadline; the scheduler can bound residency (checkpointed preemption),
+bound the queue, and reject or shed excess throughput load. With
+BENCH_TENANTS_ARMS=overload the bench runs the SAME tenant mix twice —
+a no-admission-control baseline arm (deadlines declared but every tenant
+throughput-class, unbounded queue) and an SLO arm (latency class + shed
+admission + bounded residency) — and reports per-class p50/p99 latency,
+deadline-miss rate, and rejection rate side by side.
+
 Env knobs:
   BENCH_TENANTS_JOBS=4                       tenant jobs to submit (>= 3
                                              for the acceptance run)
@@ -25,6 +34,18 @@ Env knobs:
                                              sparse store; holes read 0)
   BENCH_TENANTS_POLICY=fair_share            round_robin | fair_share
   BENCH_TENANTS_OUT=BENCH_TENANTS_r01.json   '' to skip the artifact
+  BENCH_TENANTS_LAT_FRAC=0                   fraction of tenants that are
+                                             latency-bound (every k-th)
+  BENCH_TENANTS_DEADLINE_S=0                 deadline for latency tenants
+  BENCH_TENANTS_MAX_RESIDENT=0               mesh slots (0 = unbounded,
+                                             legacy build-at-submit)
+  BENCH_TENANTS_MAX_QUEUED=0                 admission bound (0 = none)
+  BENCH_TENANTS_ADMISSION=queue              queue | reject | shed
+  BENCH_TENANTS_BASELINE=0                   1 = measure deadlines but
+                                             strip SLO classes (the
+                                             no-control baseline arm)
+  BENCH_TENANTS_ARMS=                        'overload' = run baseline +
+                                             SLO arms, combined artifact
 """
 
 from __future__ import annotations
@@ -49,17 +70,26 @@ def _pct(sorted_vals, q):
     return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
 
 
-def build_descriptors(n_jobs, rounds, dataset):
+def build_descriptors(n_jobs, rounds, dataset, lat_frac=0.0, deadline_s=None,
+                      declare_slo=True):
     """Alternating tenant kinds, each with its own seed so no two tenants
     share a cohort stream: even slots are sync-eager jobs, odd slots are
-    buffered jobs with a straggler plan, dispatched partial-cohort."""
+    buffered jobs with a straggler plan, dispatched partial-cohort.
+
+    With `lat_frac` > 0, every k-th tenant (k = round(1/lat_frac)) carries
+    `deadline_s` — the SAME tenants in every arm. `declare_slo=False` is
+    the baseline arm: deadlines are still measured, but the tenants stay
+    throughput-class so the scheduler gives them no tiering, no shedding,
+    no preemption."""
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.robustness.chaos import FaultPlan
     from fedml_tpu.serving import JobDescriptor
 
+    period = max(1, int(round(1.0 / lat_frac))) if lat_frac > 0 else 0
     descs = []
     for i in range(n_jobs):
         buffered = i % 2 == 1
+        latency = bool(period) and i % period == 0
         cfg = FedConfig(
             dataset="tenants_surrogate", model="lr", comm_round=rounds,
             batch_size=BATCH, epochs=1, lr=0.1, seed=i, ci=1,
@@ -74,11 +104,33 @@ def build_descriptors(n_jobs, rounds, dataset):
             name=f"tenant-{i:02d}-{'buf' if buffered else 'sync'}",
             config=cfg, dataset=dataset, chaos=chaos,
             weight=2.0 if buffered else 1.0,
-            partial_dispatch=buffered))
+            partial_dispatch=buffered,
+            slo="latency" if (latency and declare_slo) else "throughput",
+            deadline_s=deadline_s if latency else None))
     return descs
 
 
-def run_bench(n_jobs, rate, rounds, clients, policy):
+def _class_stats(jobs, slo_ledger):
+    """Per-SLO-class latency/deadline stats. Class membership is decided
+    by whether the tenant CARRIES a deadline, not by its declared slo —
+    so the baseline arm's undeclared latency tenants land in the same
+    bucket they occupy in the SLO arm."""
+    lats = sorted(j.finish_t - j.submit_t for j in jobs if j.done)
+    misses = sum(slo_ledger.get(j.name, {}).get("misses", 0) for j in jobs)
+    return {
+        "jobs": len(jobs),
+        "completed": len(lats),
+        "latency_p50_s": round(_pct(lats, 0.5), 4) if lats else None,
+        "latency_p99_s": round(_pct(lats, 0.99), 4) if lats else None,
+        "deadline_misses": misses,
+        "deadline_miss_rate": (round(misses / len(lats), 4)
+                               if lats else None),
+    }
+
+
+def run_bench(n_jobs, rate, rounds, clients, policy, lat_frac=0.0,
+              deadline_s=None, declare_slo=True, max_resident=None,
+              admission="queue", max_queued=None):
     from fedml_tpu.utils.cache import enable_compile_cache
 
     enable_compile_cache()
@@ -107,9 +159,13 @@ def run_bench(n_jobs, rate, rounds, clients, policy):
                               test_global=(gx, gy), class_num=CLASSES,
                               meta={})
 
-        descs = build_descriptors(n_jobs, rounds, ds)
+        descs = build_descriptors(n_jobs, rounds, ds, lat_frac=lat_frac,
+                                  deadline_s=deadline_s,
+                                  declare_slo=declare_slo)
         tracer = Tracer()
-        sched = Scheduler(policy=policy, tracer=tracer)
+        sched = Scheduler(policy=policy, tracer=tracer,
+                          max_resident=max_resident, admission=admission,
+                          max_queued=max_queued)
 
         # open loop: job i's arrival is scheduled at start + i/rate,
         # independent of completions (tracer.now() and these marks share
@@ -133,21 +189,27 @@ def run_bench(n_jobs, rate, rounds, clients, policy):
             telemetry.uninstall(tracer)
             sched.close()
 
-        last_finish = max(j.finish_t for j in sched.queue)
+        admitted = list(sched.queue)
+        completed = [j for j in admitted if j.done]
+        shed = [j for j in admitted if j.state == "cancelled"]
+        abandoned = [j for j in admitted if not j.closed]
+        last_finish = max(j.finish_t for j in completed)
         wall_s = last_finish - start
-        latencies = sorted(j.finish_t - j.submit_t for j in sched.queue)
+        latencies = sorted(j.finish_t - j.submit_t for j in completed)
         tenants = {}
-        for job in sched.queue:
-            active_s = max(job.finish_t - job.start_t, 1e-9)
-            tenants[job.name] = {
-                "kind": job.desc.kind,
-                "partial_dispatch": job.desc.partial_dispatch,
-                "rounds": job.round_idx,
-                "rounds_per_sec": round(job.round_idx / active_s, 4),
-                "latency_s": round(job.finish_t - job.submit_t, 4),
-                "dispatched_ticks": job.dispatched_ticks,
-                "compile": sched.compile_ledger.get(job.name),
-            }
+        if n_jobs <= 16:  # full per-tenant block only for small runs
+            for job in completed:
+                active_s = max(job.finish_t - job.start_t, 1e-9)
+                tenants[job.name] = {
+                    "kind": job.desc.kind,
+                    "partial_dispatch": job.desc.partial_dispatch,
+                    "rounds": job.round_idx,
+                    "rounds_per_sec": round(job.round_idx / active_s, 4),
+                    "latency_s": round(job.finish_t - job.submit_t, 4),
+                    "dispatched_ticks": job.dispatched_ticks,
+                    "compile": sched.compile_ledger.get(job.name),
+                }
+        bounced = sched.rejections
         cores = os.cpu_count() or 1
         result = {
             "metric": "serving_multitenant_jobs_per_sec",
@@ -157,10 +219,36 @@ def run_bench(n_jobs, rate, rounds, clients, policy):
             "arrival_rate_jobs_per_sec": rate,
             "rounds_per_job": rounds,
             "policy": policy,
-            "jobs_per_sec": round(n_jobs / wall_s, 4),
+            "jobs_per_sec": round(len(completed) / wall_s, 4),
             "latency_p50_s": round(_pct(latencies, 0.5), 4),
             "latency_p95_s": round(_pct(latencies, 0.95), 4),
             "wall_s": round(wall_s, 4),
+            "slo": {
+                "latency_fraction": lat_frac,
+                "deadline_s": deadline_s,
+                "declared": declare_slo,
+                "max_resident": max_resident,
+                "admission": admission,
+                "max_queued": max_queued,
+            },
+            "classes": {
+                "latency": _class_stats(
+                    [j for j in admitted if j.desc.deadline_s],
+                    sched.slo_ledger),
+                "throughput": _class_stats(
+                    [j for j in admitted if not j.desc.deadline_s],
+                    sched.slo_ledger),
+            },
+            "offered_jobs": n_jobs,
+            "admitted_jobs": len(admitted),
+            "completed_jobs": len(completed),
+            "rejected_jobs": bounced,
+            "shed_jobs": len(shed),
+            "rejection_rate": round((bounced + len(shed)) / n_jobs, 4),
+            "abandoned_jobs": len(abandoned),
+            "evictions": sched.evictions,
+            "job_rejected_events": len(tracer.find_events("job_rejected")),
+            "deadline_miss_events": len(tracer.find_events("deadline_miss")),
             "tenants": tenants,
             "clients": clients,
             "clients_per_round": CPR,
@@ -180,14 +268,67 @@ def run_bench(n_jobs, rate, rounds, clients, policy):
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
+def run_overload_arms(n_jobs, rate, rounds, clients, policy, lat_frac,
+                      deadline_s, max_resident, admission, max_queued):
+    """The r02 acceptance shape: the same tenant mix at the same offered
+    rate, once with no admission control (baseline) and once with the SLO
+    machinery on. The comparison block is the headline — the latency
+    class's deadline-miss rate must drop under the SLO arm."""
+    baseline = run_bench(n_jobs, rate, rounds, clients, policy,
+                         lat_frac=lat_frac, deadline_s=deadline_s,
+                         declare_slo=False, max_resident=max_resident,
+                         admission="queue", max_queued=None)
+    slo = run_bench(n_jobs, rate, rounds, clients, policy,
+                    lat_frac=lat_frac, deadline_s=deadline_s,
+                    declare_slo=True, max_resident=max_resident,
+                    admission=admission, max_queued=max_queued)
+    b_lat = baseline["classes"]["latency"]
+    s_lat = slo["classes"]["latency"]
+    return {
+        "metric": "serving_overload_robustness",
+        "unit": "latency-class deadline-miss rate, baseline vs SLO arm, "
+                "same tenant mix at the same offered rate",
+        "offered_rate_jobs_per_sec": rate,
+        "overload_factor_vs_r01": round(rate / 0.5, 1),
+        "jobs": n_jobs,
+        "comparison": {
+            "latency_p99_s_baseline": b_lat["latency_p99_s"],
+            "latency_p99_s_slo": s_lat["latency_p99_s"],
+            "deadline_miss_rate_baseline": b_lat["deadline_miss_rate"],
+            "deadline_miss_rate_slo": s_lat["deadline_miss_rate"],
+            "miss_rate_improved": (s_lat["deadline_miss_rate"]
+                                   < b_lat["deadline_miss_rate"]),
+            "abandoned_jobs": (baseline["abandoned_jobs"]
+                               + slo["abandoned_jobs"]),
+        },
+        "arms": {"baseline": baseline, "slo": slo},
+    }
+
+
 def main():
     n_jobs = int(os.environ.get("BENCH_TENANTS_JOBS", "4"))
     rate = float(os.environ.get("BENCH_TENANTS_RATE", "0.5"))
     rounds = int(os.environ.get("BENCH_TENANTS_ROUNDS", "5"))
     clients = int(os.environ.get("BENCH_TENANTS_CLIENTS", "1000000"))
     policy = os.environ.get("BENCH_TENANTS_POLICY", "fair_share")
+    lat_frac = float(os.environ.get("BENCH_TENANTS_LAT_FRAC", "0"))
+    deadline_s = float(os.environ.get("BENCH_TENANTS_DEADLINE_S", "0")) or None
+    max_resident = int(os.environ.get("BENCH_TENANTS_MAX_RESIDENT", "0")) or None
+    max_queued = int(os.environ.get("BENCH_TENANTS_MAX_QUEUED", "0")) or None
+    admission = os.environ.get("BENCH_TENANTS_ADMISSION", "queue")
+    baseline = os.environ.get("BENCH_TENANTS_BASELINE", "0") == "1"
+    arms = os.environ.get("BENCH_TENANTS_ARMS", "")
 
-    parsed = run_bench(n_jobs, rate, rounds, clients, policy)
+    if arms == "overload":
+        parsed = run_overload_arms(n_jobs, rate, rounds, clients, policy,
+                                   lat_frac, deadline_s, max_resident,
+                                   admission, max_queued)
+    else:
+        parsed = run_bench(n_jobs, rate, rounds, clients, policy,
+                           lat_frac=lat_frac, deadline_s=deadline_s,
+                           declare_slo=not baseline,
+                           max_resident=max_resident, admission=admission,
+                           max_queued=max_queued)
     line = json.dumps(parsed)
     print(line)
 
