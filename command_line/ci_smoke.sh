@@ -748,6 +748,81 @@ fi
 grep -q '"rss_budget_exceeded": true' /tmp/ci_scale_trip.txt
 echo "OK scale RSS budget trips"
 
+echo "== graft-pfl smoke (--adapter_bank_dir: personalized drive + lift metric)"
+# two personalized rounds over a fresh adapter bank: the eval boundary must
+# report the accuracy lift of the personalized models over the global one,
+# and two same-seed fresh-bank runs must write byte-identical shard files
+# (the bank rides the deterministic record flush, so it cannot flap)
+rm -rf /tmp/ci_pfl_bank_a /tmp/ci_pfl_bank_b
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --epochs 1 --batch_size 4 --lora_rank 4 --frequency_of_the_test 1 \
+  --adapter_bank_dir /tmp/ci_pfl_bank_a
+assert_summary "Personalization/Lift" -1.0 1.0
+assert_summary "Test/Acc" 0.0 1.0
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --epochs 1 --batch_size 4 --lora_rank 4 --frequency_of_the_test 1 \
+  --adapter_bank_dir /tmp/ci_pfl_bank_b
+for f in /tmp/ci_pfl_bank_a/*; do
+  cmp -s "$f" "/tmp/ci_pfl_bank_b/$(basename "$f")" \
+    || { echo "bank shard $(basename "$f") differs across same-seed runs"; exit 1; }
+done
+echo "OK pfl smoke: lift reported, same-seed banks byte-identical"
+
+echo "== graft-pfl resume smoke: a second run must gather the persisted rows"
+# resume on bank A: open_or_create validates row count + adapter layout
+# against the existing header, and the run trains FROM the persisted rows
+# (a layout mismatch or a zeroed bank would be a silent personalization
+# reset — open_or_create hard-fails the former; nonzero materialized rows
+# before AND after proves the latter)
+python - <<'EOF'
+from fedml_tpu.models.adapter_bank import read_side_columns
+pre = int(read_side_columns("/tmp/ci_pfl_bank_a")["mat"].sum())
+assert pre > 0, "first pfl run materialized no bank rows"
+open("/tmp/ci_pfl_mat_pre.txt", "w").write(str(pre))
+EOF
+python -m fedml_tpu.experiments.main_fedavg $COMMON --dataset mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --epochs 1 --batch_size 4 --lora_rank 4 --frequency_of_the_test 1 \
+  --adapter_bank_dir /tmp/ci_pfl_bank_a
+assert_summary "Personalization/Lift" -1.0 1.0
+python - <<'EOF'
+from fedml_tpu.models.adapter_bank import read_side_columns
+pre = int(open("/tmp/ci_pfl_mat_pre.txt").read())
+post = int(read_side_columns("/tmp/ci_pfl_bank_a")["mat"].sum())
+assert post >= pre, (pre, post)
+print(f"OK pfl resume: {pre} rows persisted, {post} materialized after resume")
+EOF
+
+echo "== 1M-row adapter-bank scale smoke (mmap shards, RSS budget gate)"
+# the bench_scale RSS budget must hold with a FULL-population adapter bank
+# in the round: gather/scatter touch O(cohort) rows of the sparse shards,
+# so a million personal adapter rows cost pages, not gigabytes
+python tools/bench_pfl.py --point --clients 1000000 --rounds 2 \
+  --rss_budget_mb 400 | tee /tmp/ci_pfl_point.txt
+python - <<'EOF'
+import json
+line = [l for l in open("/tmp/ci_pfl_point.txt") if l.startswith("{")][-1]
+p = json.loads(line)
+assert not p["rss_budget_exceeded"], p
+assert p["bank_physical_mb"] < p["bank_logical_mb"] / 10, p  # sparse shards
+assert p["gather_rows_per_sec"] > 0 and p["scatter_rows_per_sec"] > 0, p
+print(f"OK 1M-row bank point: rss={p['peak_rss_mb']}MB "
+      f"bank_physical={p['bank_physical_mb']}MB "
+      f"(logical {p['bank_logical_mb']}MB)")
+EOF
+
+echo "== pfl RSS budget self-test: a 1MB budget must trip (exit 1)"
+if python tools/bench_pfl.py --point --clients 2000 --rounds 1 \
+     --rss_budget_mb 1 >/tmp/ci_pfl_trip.txt 2>&1; then
+  echo "pfl RSS budget FAILED TO TRIP on a 1MB budget:"
+  cat /tmp/ci_pfl_trip.txt
+  exit 1
+fi
+grep -q '"rss_budget_exceeded": true' /tmp/ci_pfl_trip.txt
+echo "OK pfl RSS budget trips"
+
 echo "== fedavg equivalence oracle: full-batch E=1 FedAvg == centralized"
 python - <<'EOF'
 # the reference CI's key trick (CI-script-fedavg.sh:44-50) as a direct check

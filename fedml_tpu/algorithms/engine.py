@@ -319,6 +319,45 @@ def _vmapped_update(trainer, cfg: FedConfig) -> Callable:
     return batched
 
 
+def build_personal_local_update(trainer, cfg: FedConfig) -> Callable:
+    """personal_update(gv, x, y, count, rng, personal) ->
+    (LocalResult, new_personal) — the graft-pfl client step.
+
+    The client trains the EFFECTIVE adapters `gv["params"] + personal`
+    (elementwise tree add; the zero row — an untouched bank client — is
+    the identity, so that client's step is bit-identical to the shared
+    round) through the exact same local_update body as the shared round.
+    The trained effective adapters flow to the aggregator unchanged (the
+    global adapter aggregates as today); the client's NEW personal row is
+    the residual `trained - old_global` and returns out-of-band, never
+    entering aggregation or the wire."""
+    local_update = build_local_update(trainer, cfg)
+
+    def personal_update(global_variables, x, y, count, rng, personal):
+        effective = dict(global_variables)
+        effective["params"] = jax.tree.map(
+            jnp.add, global_variables["params"], personal)
+        result = local_update(effective, x, y, count, rng)
+        new_personal = jax.tree.map(
+            jnp.subtract, result.variables["params"],
+            global_variables["params"])
+        return result, new_personal
+
+    return personal_update
+
+
+def _vmapped_personal_update(trainer, cfg: FedConfig) -> Callable:
+    """batched(gv, x[C,...], y, counts, crngs, personal[C,...]) ->
+    (stacked LocalResult, stacked new_personal)."""
+    personal_update = build_personal_local_update(trainer, cfg)
+
+    def batched(global_variables, x, y, counts, crngs, personal):
+        return jax.vmap(personal_update, in_axes=(None, 0, 0, 0, 0, 0))(
+            global_variables, x, y, counts, crngs, personal)
+
+    return batched
+
+
 def cohort_stats(global_variables, result: LocalResult) -> dict:
     """Static-shape per-cohort health stats for the client ledger.
 
@@ -523,6 +562,55 @@ def build_round_fn(trainer, cfg: FedConfig, aggregator,
     return build_round_fn_from_update(_vmapped_update(trainer, cfg),
                                       aggregator, donate_data=donate_data,
                                       collect_stats=collect_stats)
+
+
+def build_personal_round_fn(trainer, cfg: FedConfig, aggregator,
+                            donate_data: bool = False,
+                            collect_stats: bool = False) -> Callable:
+    """Jitted personalized round (graft-pfl): vmap(personal client step)
+    + aggregate, returning the cohort's updated personal adapter rows as
+    a trailing UNAGGREGATED output.
+
+    Signature: ``round_fn(gv, agg_state, x, y, counts, rng, personal,
+    participation=None)`` — the legacy round plus one stacked ``personal``
+    cohort arg ([C, ...] adapter tree from models/adapter_bank.py's
+    gather) and one stacked ``new_personal`` output (the drive loop
+    scatters it back through the record log's one deferred device_get).
+    The aggregation stage is the legacy one verbatim: it sees the TRAINED
+    effective adapters, the personal rows never enter a psum or the wire
+    (COMMS_BUDGET pins the personalized twin's collective bytes equal to
+    the shared twin). There is no codec kwarg BY DESIGN — codec x
+    personalization is table-illegal (core/spec.py): codecs compress the
+    wire tree and personal rows never reach it.
+
+    Requires a LoRA-wrapped trainer (lora_rank > 0, table-enforced): the
+    personal row is a rank-r adapter tree mirroring gv["params"]. Dropped
+    and quarantined clients keep their OLD rows bit-exactly (chaos x
+    personalization is legal; see build_personal_round_core).
+    """
+    from fedml_tpu.core.builder import (build_personal_round_core,
+                                        donating_jit, donation_argnums)
+
+    core = build_personal_round_core(
+        _vmapped_personal_update(trainer, cfg), aggregator, collect_stats)
+
+    def round_fn(global_variables, agg_state, x, y, counts, rng, personal,
+                 participation=None):
+        new_global, new_state, metrics, stats, new_personal = core(
+            global_variables, agg_state, x, y, counts, rng, participation,
+            personal)
+        if collect_stats:
+            return new_global, new_state, metrics, stats, new_personal
+        return new_global, new_state, metrics, new_personal
+
+    from fedml_tpu import telemetry
+    telemetry.emit("round_fn_built", program="engine.round[pfl]",
+                   donate=donate_data)
+
+    # donation covers agg state (0-1) and cohort data (2-4) exactly as the
+    # shared round: `personal` is NOT donated — the drive loop's staged row
+    # buffer is also the scatter-back source on guard rejection
+    return donating_jit(round_fn, donation_argnums(donate_data=donate_data))
 
 
 def stage_to_device(x, y, counts, participation=None) -> tuple:
@@ -809,6 +897,25 @@ def build_client_eval_fn(trainer) -> Callable:
     per-client metric sums (reference _local_test_on_all_clients,
     fedavg_api.py:119-183)."""
     return jax.jit(_vmapped_client_eval(trainer))
+
+
+def build_personal_client_eval_fn(trainer) -> Callable:
+    """Per-client PERSONALIZED eval (graft-pfl lift probe): like
+    build_client_eval_fn but each client row evaluates under its own
+    effective adapters ``variables["params"] + personal[i]``. The drive
+    loop runs this next to the global eval on a sampled probe cohort and
+    logs the accuracy delta as Personalization/Lift (stored back into the
+    bank's lift column). Same mask/eval body as _vmapped_client_eval so
+    the two eval definitions cannot drift."""
+
+    def one(variables, personal, x, y, count):
+        effective = dict(variables)
+        effective["params"] = jax.tree.map(
+            jnp.add, variables["params"], personal)
+        mask = (jnp.arange(x.shape[0]) < count).astype(jnp.float32)
+        return trainer.eval_fn(effective, {"x": x, "y": y, "mask": mask})
+
+    return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, 0, 0)))
 
 
 def build_federation_eval_fn(trainer) -> Callable:

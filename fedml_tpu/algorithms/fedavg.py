@@ -201,12 +201,37 @@ class FedAvgAPI(Checkpointable):
             # cohort every round, so its buffers can be donated into the
             # round; eager callers (bench.py re-feeds one staged cohort)
             # keep the non-donating default
-            self.round_fn = build_round_fn(
-                model_trainer, config, self.aggregator,
-                donate_data=config.pipeline_depth > 0,
-                collect_stats=True)
+            if config.personalize:
+                # graft-pfl: the personalized twin — same round shape plus
+                # trailing [C, ...] personal adapter rows in/out, staged
+                # from / scattered into the mmap bank by the drive. Every
+                # other branch above is table-illegal with personalize
+                # (core/spec.py), so this is the ONLY personalized build.
+                from fedml_tpu.algorithms.engine import (
+                    build_personal_round_fn)
+
+                self.round_fn = build_personal_round_fn(
+                    model_trainer, config, self.aggregator,
+                    donate_data=config.pipeline_depth > 0,
+                    collect_stats=True)
+            else:
+                self.round_fn = build_round_fn(
+                    model_trainer, config, self.aggregator,
+                    donate_data=config.pipeline_depth > 0,
+                    collect_stats=True)
+        self._personalized = bool(config.personalize)
+        #: the attached personal adapter bank (models/adapter_bank.py) —
+        #: set by train(bank=...) or directly; required when personalizing
+        self.bank = None
         self.eval_fn = build_eval_fn(model_trainer)
         self.client_eval_fn = build_client_eval_fn(model_trainer)
+        self._personal_eval_fn = None
+        if config.personalize:
+            from fedml_tpu.algorithms.engine import (
+                build_personal_client_eval_fn)
+
+            self._personal_eval_fn = build_personal_client_eval_fn(
+                model_trainer)
         self._fed_eval_fn = build_federation_eval_fn(model_trainer)
         self._resident_cache = None
         # superstep drive state: jitted K-round programs keyed by
@@ -272,9 +297,15 @@ class FedAvgAPI(Checkpointable):
                 rng = jax.random.fold_in(rng, rng_salt)
             args = [self.global_variables, self.agg_state, staged.x,
                     staged.y, staged.counts, rng]
+            if staged.personal is not None:
+                args.append(staged.personal["tree"])
             if staged.participation is not None:
                 args.append(staged.participation)
-            if self._round_has_stats:
+            new_personal = None
+            if self._personalized:
+                (self.global_variables, self.agg_state, train_metrics,
+                 stats, new_personal) = self.round_fn(*args)
+            elif self._round_has_stats:
                 (self.global_variables, self.agg_state, train_metrics,
                  stats) = self.round_fn(*args)
             else:
@@ -283,8 +314,11 @@ class FedAvgAPI(Checkpointable):
                 stats = None
         # the drive loops pick the cohort's ledger stats up from here; the
         # stats arrays stay device-resident until RoundRecordLog's deferred
-        # flush fetch — train_one_round itself never syncs on them
+        # flush fetch — train_one_round itself never syncs on them. The
+        # personal rows defer the same way (_bank_block -> record["_bank"]).
         self._last_dispatch = (staged, stats)
+        self._last_personal = ((staged.personal["rows"], new_personal)
+                               if staged.personal is not None else None)
         with tracer.span("metrics_fetch", round_idx):
             # ONE host round trip for the whole metrics dict — per-key float()
             # was one blocking transfer per metric through the driver tunnel
@@ -292,7 +326,7 @@ class FedAvgAPI(Checkpointable):
 
     def train(self, ckpt_dir: str | None = None, ckpt_every: int = 25,
               metrics_logger=None, chaos=None, guard=None,
-              tracer=None, ledger=None) -> list[dict[str, Any]]:
+              tracer=None, ledger=None, bank=None) -> list[dict[str, Any]]:
         """Drive loop. `chaos` (robustness.chaos.FaultPlan) injects a seeded
         deterministic fault schedule per round; `guard`
         (robustness.guard.RoundGuard) inspects every round and, on a bad
@@ -320,8 +354,27 @@ class FedAvgAPI(Checkpointable):
         per-client health ledger: every drive's per-cohort stats rows are
         scatter-written into it from RoundRecordLog's flush. Attaching a
         ledger changes NO traced program and adds NO sync points — final
-        params are bit-identical with it on or off."""
+        params are bit-identical with it on or off.
+
+        `bank` (models.adapter_bank.AdapterBank, graft-pfl) attaches the
+        personal adapter bank a personalized run REQUIRES: cohort rows are
+        gathered at staging, the round's updated rows ride
+        RoundRecordLog's one deferred device_get and scatter back from its
+        flush (`_bank` blocks), and the probe lift eval writes the lift
+        sidecar on test rounds. Cluster sharing (--adapter_clusters) maps
+        clients onto bank rows through the attached ledger's ema_loss
+        column."""
         cfg = self.cfg
+        if bank is not None:
+            self.bank = bank
+        if cfg.personalize and self.bank is None:
+            raise ValueError(
+                "personalize=True needs an attached adapter bank "
+                "(models/adapter_bank.py) — pass --adapter_bank_dir on the "
+                "CLI or train(bank=...)")
+        #: cluster-mode row assignment reads the SAME ledger the stats
+        #: scatter into (ema_loss column)
+        self._drive_ledger = ledger
         owns_tracer = tracer is None
         if tracer is None:
             tracer = telemetry.Tracer(
@@ -363,6 +416,10 @@ class FedAvgAPI(Checkpointable):
                     with tracer.span("checkpoint"):
                         self.save_checkpoint(ckpt_dir, cfg.comm_round)
         finally:
+            if self.bank is not None:
+                # memmap writes are already durable pages; flush fsyncs so
+                # a resumed run reads the bank bitwise
+                self.bank.flush()
             telemetry.uninstall(tracer)
             if owns_tracer:
                 tracer.close()
@@ -375,7 +432,7 @@ class FedAvgAPI(Checkpointable):
         the same `RoundRecordLog` path as the pipelined loop (one code path
         for history/metrics/ledger), flushed every round."""
         records = RoundRecordLog(tracer, self.history, metrics_logger,
-                                 ledger=ledger)
+                                 ledger=ledger, bank=self.bank)
         round_idx = start_round
         while round_idx < self.cfg.comm_round:
             round_idx = self._eager_round(round_idx, records, chaos=chaos,
@@ -434,6 +491,9 @@ class FedAvgAPI(Checkpointable):
                     block = self._ledger_block(round_idx, *self._last_dispatch)
                     if block is not None:
                         record["_ledger"] = [block]
+                    bank_block = self._bank_block(round_idx)
+                    if bank_block is not None:
+                        record["_bank"] = [bank_block]
                     if faults is not None:
                         record.update(chaos_summary(faults))
                         for k in ("participated_count", "quarantined_count"):
@@ -445,6 +505,7 @@ class FedAvgAPI(Checkpointable):
                         with tracer.span("eval", round_idx):
                             record.update(self.local_test_on_all_clients(round_idx))
                             record.update(self.test_global(round_idx))
+                            record.update(self.personalization_lift(round_idx))
                     records.add(record)
                     records.flush(round_idx)
                     if ckpt_dir and (round_idx + 1) % ckpt_every == 0:
@@ -708,6 +769,34 @@ class FedAvgAPI(Checkpointable):
                 "participated": participated,
                 "stats": stats}
 
+    def _bank_block(self, round_idx):
+        """One personal-row block for a round record's `_bank` key — the
+        rows stay device-resident until the record log's single deferred
+        device_get, then AdapterBank.apply scatters them (graft-pfl)."""
+        last = getattr(self, "_last_personal", None)
+        if last is None:
+            return None
+        rows, new_personal = last
+        return {"round": round_idx, "client_idx": np.asarray(rows),
+                "rows": new_personal}
+
+    def _bank_rows(self, idx) -> np.ndarray:
+        """Bank row ids for a cohort: the client ids themselves (one row
+        per client), or their EMA-loss cluster buckets under
+        --adapter_clusters K (the bank holds K shared rows; assignment is
+        a static O(cohort) bucket of the attached ledger's ema_loss
+        column — a missing ledger reads as loss 0, bucket 0)."""
+        idx = np.asarray(idx, np.int64)
+        k = self.cfg.adapter_clusters
+        if k <= 0:
+            return idx
+        from fedml_tpu.models.adapter_bank import cluster_rows
+
+        ledger = getattr(self, "_drive_ledger", None)
+        ema = (np.asarray(ledger.column("ema_loss"))[idx]
+               if ledger is not None else np.zeros(idx.size, np.float32))
+        return cluster_rows(ema, k)
+
     # --------------------------------------------------------- stage seam
     def _stage_cohort(self, round_idx: int, chaos=None, faults=None,
                       tracer=None) -> StagedCohort:
@@ -747,9 +836,26 @@ class FedAvgAPI(Checkpointable):
                     participation = np.concatenate(
                         [participation,
                          np.zeros(counts.shape[0] - n_before, bool)])
+            personal = None
+            if self.cfg.personalize:
+                if self.bank is None:
+                    raise ValueError(
+                        "personalize=True needs an attached adapter bank "
+                        "(models/adapter_bank.py) — pass --adapter_bank_dir "
+                        "on the CLI or train(bank=...)")
+                # O(cohort) coalesced preads; never-scattered clients come
+                # back as zero rows (the personalization identity). The
+                # mesh-pad branch above is unreachable here — every meshed
+                # lowering is table-illegal with personalize.
+                rows = self._bank_rows(idx)
+                with tracer.span("bank_gather", round_idx, rows=len(rows)):
+                    gathered = self.bank.gather(rows)
         with tracer.span("h2d", round_idx):
             dx, dy, dc, dp = stage_to_device(x, y, counts, participation)
-        return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx)
+            if self.cfg.personalize:
+                personal = {"rows": rows, "tree": jax.device_put(gathered)}
+        return StagedCohort(round_idx, dx, dy, dc, dp, faults, idx,
+                            personal=personal)
 
     def stage_partial_cohort(self, round_idx: int, width: int, cohort: int,
                              chaos=None, tracer=None) -> StagedCohort:
@@ -809,7 +915,7 @@ class FedAvgAPI(Checkpointable):
         # shared RoundRecordLog; structured events (chaos, rollback) hit the
         # ledger the moment they occur, so a crash mid-flush cannot lose them
         records = RoundRecordLog(tracer, self.history, metrics_logger,
-                                 ledger=ledger)
+                                 ledger=ledger, bank=self.bank)
         self._last_records = records  # test/ops introspection (max_pending)
         inflight: deque = deque()
 
@@ -822,6 +928,22 @@ class FedAvgAPI(Checkpointable):
                         staged = prefetcher.get(round_idx)
                     # a rolled-back timeline can never leak a stale cohort in
                     assert staged.round_idx == round_idx
+                    if self._personalized:
+                        # read-after-write: this round's gather must see the
+                        # previous round's scatter, but the prefetcher staged
+                        # this cohort's personal rows ahead of that flush —
+                        # commit pending bank blocks and re-gather NOW. Data
+                        # buffers stay pipelined; only the (rank-r tiny)
+                        # personal rows restage, and the per-round flush
+                        # keeps the eager loop's exact write-then-read order
+                        # (personalized pipelined == eager bit-exactly).
+                        records.flush(round_idx)
+                        rows = self._bank_rows(staged.client_idx)
+                        with tracer.span("bank_gather", round_idx,
+                                         rows=len(rows)):
+                            staged.personal = {
+                                "rows": rows,
+                                "tree": jax.device_put(self.bank.gather(rows))}
                     for ahead in range(1, cfg.pipeline_depth + 1):
                         if round_idx + ahead < cfg.comm_round:
                             prefetcher.prefetch(round_idx + ahead)
@@ -835,9 +957,16 @@ class FedAvgAPI(Checkpointable):
                             rng = jax.random.fold_in(rng, retries)
                         args = [self.global_variables, self.agg_state, staged.x,
                                 staged.y, staged.counts, rng]
+                        if staged.personal is not None:
+                            args.append(staged.personal["tree"])
                         if staged.participation is not None:
                             args.append(staged.participation)
-                        if self._round_has_stats:
+                        new_personal = None
+                        if self._personalized:
+                            (self.global_variables, self.agg_state,
+                             train_metrics, stats,
+                             new_personal) = self.round_fn(*args)
+                        elif self._round_has_stats:
                             (self.global_variables, self.agg_state,
                              train_metrics, stats) = self.round_fn(*args)
                         else:
@@ -887,6 +1016,14 @@ class FedAvgAPI(Checkpointable):
                         # stats stay device-resident in the pending record;
                         # they resolve in the flush's one deferred device_get
                         record["_ledger"] = [block]
+                    if staged.personal is not None:
+                        # personal rows defer exactly like the stats: device
+                        # arrays pending until the flush fetch, then the
+                        # bank scatter (records.py `_bank`)
+                        record["_bank"] = [{
+                            "round": round_idx,
+                            "client_idx": np.asarray(staged.personal["rows"]),
+                            "rows": new_personal}]
                     if staged.faults is not None:
                         record.update(chaos_summary(staged.faults))
                         for k in ("participated_count", "quarantined_count"):
@@ -901,6 +1038,7 @@ class FedAvgAPI(Checkpointable):
                         with tracer.span("eval", round_idx):
                             record.update(self.local_test_on_all_clients(round_idx))
                             record.update(self.test_global(round_idx))
+                            record.update(self.personalization_lift(round_idx))
                     records.add(record)
                     # flush at sync points, and ALSO whenever the pending
                     # backlog exceeds ~2x the pipeline depth: unbounded
@@ -961,6 +1099,37 @@ class FedAvgAPI(Checkpointable):
             "Test/Acc": m.get("test_correct", 0.0) / total,
             "Test/Loss": m.get("test_loss", 0.0) / total,
         }
+
+    def personalization_lift(self, round_idx: int,
+                             probe: int = 64) -> dict[str, float]:
+        """Accuracy lift of the personalized model over the global one on
+        a sampled probe cohort (graft-pfl eval): each probe client
+        evaluates under `params + its personal row` AND under the bare
+        globals on its test split; the per-client delta lands in the
+        bank's lift sidecar (tools/client_report.py surfaces it) and the
+        probe mean logs as Personalization/Lift. O(probe) work and reads
+        — never the full federation, never the million-row bank. {} when
+        the run isn't personalized (test rounds stay byte-identical)."""
+        if self.bank is None or not self.cfg.personalize:
+            return {}
+        ds = self.dataset
+        n = min(probe, ds.client_num)
+        idx = client_sampling(round_idx, ds.client_num, n)
+        rows = self._bank_rows(idx)
+        packed = ds.test or ds.train
+        x, y, counts = packed.select(idx)
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        counts = jnp.asarray(counts)
+        personal = jax.device_put(self.bank.gather(rows))
+        m_p = self._personal_eval_fn(self.global_variables, personal,
+                                     x, y, counts)
+        m_g = self.client_eval_fn(self.global_variables, x, y, counts)
+        m_p, m_g = jax.device_get((m_p, m_g))
+        total = np.maximum(np.asarray(m_g["test_total"], np.float64), 1.0)
+        lift = ((np.asarray(m_p["test_correct"], np.float64)
+                 - np.asarray(m_g["test_correct"], np.float64)) / total)
+        self.bank.write_lift(rows, lift)
+        return {"Personalization/Lift": float(lift.mean())}
 
     def local_test_on_all_clients(self, round_idx: int) -> dict[str, float]:
         """Reference _local_test_on_all_clients (fedavg_api.py:119-183): run the

@@ -51,6 +51,17 @@ itself the `bare-suppression` finding):
   codec-off bit-identity contract stay in sync; a hand-built codec with
   ad-hoc parameters would run under a budget pin measured for different
   wire bytes.
+- `personal-state-in-federated-tree`: a personal-adapter collection (any
+  argument whose name mentions "personal") handed to a federated-tree
+  surface — the aggregator/collective tail (`psum`, `pmean`, `all_reduce`,
+  `aggregate`, `masked_psum_tail`), the update-codec encode path (`encode`,
+  `wrap_codec`), or checkpointing (`save_checkpoint`). Personal rows are
+  client-private BY CONTRACT (graft-pfl): the aggregator sees only trained
+  effective params, the wire carries zero extra bytes, and persistence is
+  the mmap adapter bank — a personal tree reaching any of those surfaces
+  either leaks private state into the global model/checkpoint or breaks
+  the pinned COMMS twin equality. Blessed path: `models/adapter_bank.py`
+  (the bank IS the sanctioned persistence for personal rows).
 - `full-store-materialize`: `np.asarray(store.x)` / `np.stack(...)` /
   `store.x[:]` whole-store reads over a packed/streaming client store —
   the data plane's O(cohort) contract (data/packed_store.py) dies the
@@ -692,6 +703,62 @@ class _UnregisteredCodec(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _PersonalStateInFederatedTree(ast.NodeVisitor):
+    """personal-state-in-federated-tree: personal rows never federate.
+
+    The graft-pfl privacy/bit-identity contract has three walls: personal
+    adapter rows are never summed into the global tree (the aggregator
+    input is the TRAINED effective params, the delta returns unaggregated),
+    never encoded onto the wire (the COMMS twin gate pins pfl collective
+    bytes == non-pfl), and never ride the global checkpoint (the mmap bank
+    owns persistence, byte-stably). This rule is the static tripwire for
+    all three: a call whose dotted tail is one of the federated-tree
+    surfaces with an argument that names personal state is a contract
+    breach no matter what the runtime gates happen to measure that day.
+    Matching is by identifier substring ("personal" in a Name or attribute
+    chain inside the argument), so `new_personal`, `staged.personal`,
+    `personal_rows` all trip; calls inside models/adapter_bank.py are
+    blessed (lint_source path-scopes the visitor away from it)."""
+
+    _SURFACE_TAILS = {"psum", "pmean", "all_reduce", "aggregate",
+                      "masked_psum_tail", "encode", "wrap_codec",
+                      "save_checkpoint"}
+
+    def __init__(self, path: str, lines: List[str], findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+
+    @staticmethod
+    def _personal_names(expr) -> List[str]:
+        names = []
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and "personal" in sub.id:
+                names.append(sub.id)
+            elif isinstance(sub, ast.Attribute) and "personal" in sub.attr:
+                names.append(sub.attr)
+        return names
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail in self._SURFACE_TAILS:
+            exprs = list(node.args) + [k.value for k in node.keywords
+                                       if k.value is not None]
+            hits = [n for e in exprs for n in self._personal_names(e)]
+            if hits and not is_suppressed(self.lines, node.lineno,
+                                          "personal-state-in-federated-tree"):
+                self.findings.append(Finding(
+                    "personal-state-in-federated-tree",
+                    f"{self.path}:{node.lineno}",
+                    f"personal adapter state ({hits[0]!r}) reaches the "
+                    f"federated-tree surface `{name}(...)` — personal rows "
+                    f"are client-private: they never aggregate, never hit "
+                    f"the update codec, and persist only through "
+                    f"models/adapter_bank.py"))
+        self.generic_visit(node)
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
     """Run all AST rules on one module's source text."""
     try:
@@ -709,6 +776,12 @@ def lint_source(source: str, path: str) -> List[Finding]:
             _RuleRunner(info, path, lines, findings).visit(info.node)
     _SyncIdiom(path, lines, findings).visit(tree)
     _UnschemaEvent(path, lines, findings).visit(tree)
+    # the bank is the ONE sanctioned persistence path for personal rows —
+    # everywhere else, personal state reaching a federated surface is a
+    # privacy/bit-identity breach (see _PersonalStateInFederatedTree)
+    norm = path.replace(os.sep, "/")
+    if not norm.endswith("models/adapter_bank.py"):
+        _PersonalStateInFederatedTree(path, lines, findings).visit(tree)
     _FullStoreMaterialize(path, lines, findings,
                           _blessed_store_ranges(col)).visit(tree)
     # drive-loop fetch hygiene is an algorithms/-driver contract: that is

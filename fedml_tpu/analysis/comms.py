@@ -376,6 +376,47 @@ def _engine_round():
     return round_fn, args, _tree_bytes(gv)
 
 
+def _engine_lora_round(pfl: bool = False):
+    """The single-chip federated-LoRA engine round and (pfl=True) its
+    personalized twin (graft-pfl): same trainer, same aggregator, same
+    cohort geometry — the pfl twin adds the trailing [C, ...] personal
+    adapter rows in and out. BOTH pin zero collectives (1-device vmap
+    programs), and the pair backs the 'wire bytes unchanged' contract:
+    run_comms gates the pfl twin's collective bytes EQUAL to the shared
+    twin's (the personal rows ride outputs, never a psum)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.aggregators import make_aggregator
+    from fedml_tpu.algorithms.engine import (build_personal_round_fn,
+                                             build_round_fn)
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.models.lora import LoRATrainer, strip_lora_base
+
+    cfg = FedConfig(model="lr", batch_size=2, epochs=1, dtype="float32",
+                    lora_rank=8, personalize=pfl)
+    trainer = LoRATrainer(_lr_trainer(), rank=8)
+    agg = make_aggregator("fedavg", cfg)
+    gv, rng = _abstract_gv(trainer, (2, 32), jnp.float32)
+    agg_state = jax.eval_shape(agg.init_state, gv)
+    c, n = 2, 4
+    data = (jax.ShapeDtypeStruct((c, n, 32), jnp.float32),
+            jax.ShapeDtypeStruct((c, n), jnp.int32),
+            jax.ShapeDtypeStruct((c,), jnp.int32), rng)
+    if pfl:
+        round_fn = build_personal_round_fn(trainer, cfg, agg)
+        personal = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((c,) + l.shape, l.dtype),
+            gv["params"])
+        args = (gv, agg_state) + data + (personal,)
+    else:
+        round_fn = build_round_fn(trainer, cfg, agg)
+        args = (gv, agg_state) + data
+    # 4th element: the federated (wire) tree is adapters-only under LoRA
+    # — IDENTICAL for both twins, personal rows are not wire traffic
+    return round_fn, args, _tree_bytes(gv), _tree_bytes(strip_lora_base(gv))
+
+
 def _chunked_chunk_fn():
     import jax
     import jax.numpy as jnp
@@ -457,10 +498,16 @@ PROGRAMS: Dict[str, Tuple[Callable, int]] = {
     "sequence.ring[b1,t64,h8,d16]": (_ring_attention, N_DEV),
     "sequence.ulysses[b1,t64,h8,d16]": (_ulysses_attention, N_DEV),
     "engine.round[lr,f32,fedavg]": (_engine_round, 1),
+    "engine.round[lr,f32,fedavg,lora8]": (
+        lambda: _engine_lora_round(pfl=False), 1),
+    "engine.round[lr,f32,fedavg,lora8,pfl]": (
+        lambda: _engine_lora_round(pfl=True), 1),
     "engine.chunked.chunk_fn[lr]": (_chunked_chunk_fn, 1),
 }
 
 EXTRA_PROGRAMS = ("engine.round[lr,f32,fedavg]",
+                  "engine.round[lr,f32,fedavg,lora8]",
+                  "engine.round[lr,f32,fedavg,lora8,pfl]",
                   "engine.chunked.chunk_fn[lr]")
 
 _BUDGET_KEYS = ("collective_count", "collective_bytes", "peak_bytes",
@@ -479,6 +526,13 @@ _STEP_PEAK_GATE = ("tensor.step[tformer,f32,2x4]",
 _LORA_STACK_GATE = ("tensor.round[tformer,f32,fedavg,2x4,lora8,topk64]",
                     ("tensor.round[tformer,f32,fedavg,2x4,lora8]",
                      "tensor.round[tformer,f32,fedavg,2x4,topk64]"))
+
+# personalization is wire-free by construction (graft-pfl): the pfl twin
+# must move EXACTLY the collective bytes of its shared-LoRA twin (both
+# zero on the single chip) — any delta means personal rows leaked into a
+# collective
+_PFL_WIRE_GATE = ("engine.round[lr,f32,fedavg,lora8,pfl]",
+                  "engine.round[lr,f32,fedavg,lora8]")
 
 
 def load_budgets(repo_root: str) -> Dict[str, Dict[str, int]]:
@@ -618,6 +672,17 @@ def run_comms(repo_root: str, fast: bool = False,
                 f"({single.collective_bytes}B); the codec must compress "
                 f"the adapter deltas, not the full tree (the shrinks are "
                 f"multiplicative by construction)")])
+    pfl_name, shared_name = _PFL_WIRE_GATE
+    pfl, shared = programs.get(pfl_name), programs.get(shared_name)
+    if (pfl is not None and shared is not None
+            and pfl.collective_bytes != shared.collective_bytes):
+        report.extend([Finding(
+            "comms-budget", pfl_name,
+            f"personalized round moved {pfl.collective_bytes}B of "
+            f"collectives vs {shared.collective_bytes}B for its shared "
+            f"twin — personal adapter rows must ride program OUTPUTS "
+            f"(models/adapter_bank.py scatter), never a psum; wire bytes "
+            f"are contractually unchanged by --personalize")])
 
     if update_budgets:
         budgets = make_budgets(programs, existing=load_budgets(repo_root),

@@ -67,6 +67,12 @@ RULES = {
                           "fedml_tpu.codecs.make_codec (call-site literals "
                           "desync the codec from FedConfig and its budget "
                           "program twins)",
+    "personal-state-in-federated-tree": "personal adapter state passed to "
+                                        "an aggregator/codec/checkpoint "
+                                        "surface (psum/aggregate/encode/"
+                                        "save_checkpoint...) — personal rows "
+                                        "are client-private and persist only "
+                                        "through models/adapter_bank.py",
     "bare-suppression": "graft-lint: disable comment without a '-- reason'",
     # Matrix-layer rules (matrix_engine / --matrix): the declarative
     # RoundProgramSpec (core/spec.py) vs the repo.

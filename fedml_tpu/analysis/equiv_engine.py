@@ -343,6 +343,23 @@ def legacy_round_programs(levels: Mapping[str, str], **extra):
 
             rule = CodecAggregator(codec, agg, slots=2)
             agg_state = jax.eval_shape(rule.init_state, gv)
+        if levels.get("personalization") == "on" and fam == "engine":
+            # the personalized hand assembly: thread the trailing
+            # [C, ...] personal adapter rows exactly as the runtime
+            # drive does (codec x personalization is table-illegal, so
+            # `rule` is always the bare aggregator here)
+            from fedml_tpu.algorithms.engine import build_personal_round_fn
+
+            fn = build_personal_round_fn(trainer, cfg, rule,
+                                         donate_data=donate,
+                                         collect_stats=stats)
+            personal = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct((2,) + l.shape, l.dtype),
+                gv["params"])
+            args = (gv, agg_state, x, y, counts, rng, personal)
+            if chaos:
+                args = args + (mask,)
+            return (RoundProgram("engine.round", fn, args),)
         fn = build_round_fn(trainer, cfg, rule, donate_data=donate,
                             collect_stats=stats)
         args = (gv, agg_state, x, y, counts, rng)

@@ -467,6 +467,24 @@ def _trace_engine_round(point, ctx) -> None:
         round_f = build_round_fn(ftrainer, fcfg, agg)
         jax.eval_shape(round_f, fgv, agg_state, fx, fy, fcounts, frng)
         return
+    if point.opt("pfl"):
+        # personalized twin (a --personalize run reaches it): the
+        # federated-LoRA round plus trailing [C, ...] personal adapter
+        # rows in and out — a distinct jit signature the budget pins as
+        # its own program (graft-pfl, models/adapter_bank.py)
+        from fedml_tpu.algorithms.engine import build_personal_round_fn
+        from fedml_tpu.models.lora import LoRATrainer
+
+        ptrainer = LoRATrainer(trainer, rank=point.opt("lora_rank"))
+        pgv, px, py, pcounts, prng = _abstract_round_args(
+            ptrainer, ctx["shape"], ctx["in_dtype"])
+        round_p = build_personal_round_fn(ptrainer, cfg, agg)
+        personal = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((2,) + l.shape, l.dtype),
+            pgv["params"])
+        jax.eval_shape(round_p, pgv, jax.eval_shape(agg.init_state, pgv),
+                       px, py, pcounts, prng, personal)
+        return
     if point.opt("lora_rank"):
         # federated-LoRA round (a --lora_rank run reaches it): adapters
         # under "params", frozen base riding as the lora_base collection —

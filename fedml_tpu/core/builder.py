@@ -150,6 +150,66 @@ def build_round_core(batched_update, aggregator,
     return core
 
 
+def build_personal_round_core(batched_update, aggregator,
+                              collect_stats: bool) -> Callable:
+    """The personalized-round body (graft-pfl): `build_round_core`'s shape
+    plus a trailing [C, ...]-stacked `personal` adapter tree in and the
+    updated rows out — UNAGGREGATED. The personal rows never reach the
+    aggregator or any collective (COMMS_BUDGET pins the personalized
+    twin's collective bytes equal to the shared round's); they ride the
+    outputs like ledger stats do and scatter back into the mmap bank on
+    the host. `batched_update(gv, x, y, counts, crngs, personal) ->
+    (LocalResult, new_personal)` — engine._vmapped_personal_update.
+
+    Returns core(gv, agg_state, x, y, counts, rng, participation,
+    personal) -> (new_gv, new_state, metrics, stats-or-None,
+    new_personal). Under the chaos mask, a dropped or quarantined
+    client's personal row passes through UNCHANGED — its bank row must
+    not absorb a poisoned or never-run update."""
+    from fedml_tpu.algorithms.aggregators import quarantine_stage
+    from fedml_tpu.algorithms.engine import cohort_stats
+    from fedml_tpu.models.lora import attach_lora_base, strip_lora_base
+
+    def _keep_dead_rows(new_personal, personal, alive):
+        return jax.tree.map(
+            lambda n, o: jnp.where(
+                alive.reshape(alive.shape + (1,) * (n.ndim - 1)), n, o),
+            new_personal, personal)
+
+    def core(global_variables, agg_state, x, y, counts, rng, participation,
+             personal):
+        crngs = jax.random.split(rng, x.shape[0])
+        result, new_personal = batched_update(
+            global_variables, x, y, counts, crngs, personal)
+        stats = cohort_stats(global_variables, result) if collect_stats \
+            else None
+        weights = counts.astype(jnp.float32)
+        if participation is None:
+            new_global, new_state = aggregator(
+                global_variables, result, weights, rng, agg_state
+            )
+            new_global = attach_lora_base(new_global, global_variables)
+            metrics = {k: v.sum() for k, v in result.metrics.items()}
+            return new_global, new_state, metrics, stats, new_personal
+        result, weights, alive, quarantined = quarantine_stage(
+            result, weights, participation)
+        new_global, new_state = aggregator(
+            global_variables, result, weights, rng, agg_state
+        )
+        any_alive = jnp.any(alive)
+        new_global = tree_where(any_alive, new_global,
+                                strip_lora_base(global_variables))
+        new_state = tree_where(any_alive, new_state, agg_state)
+        new_global = attach_lora_base(new_global, global_variables)
+        metrics = {k: v.sum() for k, v in result.metrics.items()}
+        metrics["participated_count"] = alive.sum().astype(jnp.float32)
+        metrics["quarantined_count"] = quarantined.sum().astype(jnp.float32)
+        new_personal = _keep_dead_rows(new_personal, personal, alive)
+        return new_global, new_state, metrics, stats, new_personal
+
+    return core
+
+
 def masked_psum_tail(new_global, new_state, metrics, alive, quarantined,
                      fallback_global, fallback_state, axis: str):
     """The masked round's shard-local no-op guard + fault metrics, shared
@@ -281,6 +341,22 @@ def build_round_program(levels: Mapping[str, str],
 
         rule = wrap_codec(agg, codec, slots=cohort)
         agg_state = jax.eval_shape(rule.init_state, gv)
+        if eff.get("personalization") == "on" and fam == "engine":
+            # the personalized twin: trailing [C, ...] personal rows in
+            # and out of the SAME round shape (codec x personalization
+            # and fused x personalization are table-illegal)
+            from fedml_tpu.algorithms.engine import build_personal_round_fn
+
+            fn = build_personal_round_fn(trainer, cfg, rule,
+                                         donate_data=donate,
+                                         collect_stats=stats)
+            personal = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct((cohort,) + l.shape,
+                                               l.dtype), gv["params"])
+            args = (gv, agg_state, x, y, counts, rng, personal)
+            if chaos:
+                args = args + (jax.ShapeDtypeStruct((cohort,), jnp.bool_),)
+            return (RoundProgram("engine.round", fn, args),)
         fn = build_round_fn(trainer, cfg, rule, donate_data=donate,
                             collect_stats=stats)
         args = (gv, agg_state, x, y, counts, rng)

@@ -115,6 +115,22 @@ class FedConfig:
     # program stays bit-identical. Opt-in; default keeps the shard_map
     # storage-sharded round.
     shard_step: bool = False
+    # Per-client personalization (models/adapter_bank.py): each client's
+    # local step trains global adapters + its PERSONAL adapter row
+    # (elementwise sum — the zero row is the identity, so a client's
+    # first personalized round is bit-identical to the shared round),
+    # and the round program returns the updated personal rows
+    # UNAGGREGATED — they never enter the psum, wire bytes unchanged
+    # (COMMS_BUDGET pins the personalized twin's collective bytes equal
+    # to the shared one). Requires lora_rank > 0 (the personal row IS a
+    # rank-r adapter). False = structurally off: the personalized round
+    # builder is never invoked and every drive loop traces the exact
+    # legacy program (EQUIV_PAIRS "personalization-off").
+    personalize: bool = False
+    # With personalize: >0 shares adapter rows per EMA-loss cluster
+    # instead of per client — the bank holds K rows, cluster id is a
+    # static bucket of the ledger's ema_loss column (O(cohort)/round).
+    adapter_clusters: int = 0
     # >0 wraps the trainer in LoRA (models/lora.py): base params frozen
     # under a "lora_base" collection (tensor-sharded on the 2D mesh),
     # rank-r adapters under "params" — only adapters are federated,

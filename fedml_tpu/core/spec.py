@@ -91,6 +91,11 @@ AXES: Dict[str, Axis] = {a.name: a for a in (
          None, "in-round participation mask + quarantine (FaultPlan arm)"),
     Axis("stats", ("off", "on"), "off",
          None, "per-cohort ledger stats rows (collect_stats builder kwarg)"),
+    Axis("personalization", ("off", "on"), "off",
+         {"off": {"personalize": False}, "on": {"personalize": True}},
+         "per-client personal adapter rows from the mmap bank "
+         "(models/adapter_bank.py): trained alongside the global "
+         "adapters, returned UNAGGREGATED — never on the wire"),
 )}
 
 
@@ -113,6 +118,8 @@ _PROJECTIONS: Dict[str, Callable] = {
     "pipeline": lambda cfg: "on" if cfg.pipeline_depth > 0 else "off",
     "superstep": lambda cfg: "on" if cfg.rounds_per_dispatch > 1 else "off",
     "codec": lambda cfg: cfg.update_codec,
+    "personalization": lambda cfg: ("on" if getattr(cfg, "personalize",
+                                                    False) else "off"),
 }
 
 
@@ -176,6 +183,12 @@ _TENSOR_REASON = (
     "tensor_shards already places rounds on its own 2D "
     "('clients', 'tensor') mesh — combine it with neither "
     "silo_threshold nor backend='shard_map'")
+_PFL_REASON = (
+    "personalize (per-client adapter rows, models/adapter_bank.py) "
+    "drives the single-chip vmap engine's eager or pipelined loop — "
+    "the fused/superstep/buffered/shard_map/tensor/silo lowerings "
+    "have no personal-row seam; drop personalize or the conflicting "
+    "setting")
 
 # Order matters: for a config violating several pairs, the FIRST matching
 # exclusion's reason is raised — the order below mirrors the firing order
@@ -239,6 +252,28 @@ EXCLUSIONS: Tuple[Exclusion, ...] = (
               "the fused kernel round has no participation/quarantine "
               "stage — run without chaos faults or cohort padding, or "
               "drop --fused_kernel"),
+    # graft-pfl: the personalized round is a vmap-engine program (eager or
+    # pipelined drive) — the other families have no personal-row seam, and
+    # the bank scatter rides the per-round RoundRecordLog flush that the
+    # superstep/buffered loops restructure.
+    Exclusion("personalization", ("on",), "fused", ("on",), _PFL_REASON),
+    Exclusion("personalization", ("on",), "superstep", ("on",),
+              _PFL_REASON),
+    Exclusion("personalization", ("on",), "buffer", ("on",), _PFL_REASON),
+    Exclusion("personalization", ("on",), "backend", ("shard_map",),
+              _PFL_REASON),
+    Exclusion("personalization", ("on",), "tensor", _TENSOR_ON,
+              _PFL_REASON),
+    Exclusion("personalization", ("on",), "silo", ("on",), _PFL_REASON),
+    Exclusion("personalization", ("on",), "codec", _CODEC_ON,
+              "update codecs compress the WIRE tree, and personal rows "
+              "never reach the wire — a codec on the personalized round "
+              "would stage deltas for a tree the client step does not "
+              "ship; drop one of update_codec / personalize"),
+    Exclusion("personalization", ("on",), "lora", ("off",),
+              "personalize trains a PERSONAL rank-r adapter per client on "
+              "top of the shared adapters — it requires lora_rank > 0 "
+              "(models/adapter_bank.py rows are LoRA adapter trees)"),
 )
 
 
@@ -308,6 +343,9 @@ REQUIREMENTS: Tuple[Requirement, ...] = (
     Requirement("fused", "on", lambda cfg: cfg.grad_clip is not None,
                 "the fused kernel clips unconditionally (reference "
                 "semantics) — grad_clip must be set"),
+    Requirement("personalization", "on", lambda cfg: cfg.lora_rank > 0,
+                "personalize requires lora_rank > 0 — the personal row "
+                "is a rank-r adapter tree (models/adapter_bank.py)"),
 )
 
 
@@ -357,7 +395,8 @@ def validate_config(cfg, axes: Optional[Mapping[str, str]] = None) -> None:
 # the tables, so they cannot alter the traced program. Consumed by the
 # matrix engine's cover dedup and by core/builder.py's composition.
 _FAMILY_TRACE_AXES: Dict[str, Tuple[str, ...]] = {
-    "engine": ("aggregator", "codec", "lora", "chaos", "stats", "pipeline"),
+    "engine": ("aggregator", "codec", "lora", "chaos", "stats", "pipeline",
+               "personalization"),
     "fused": ("aggregator", "stats", "pipeline"),
     "superstep": ("aggregator", "codec", "lora", "chaos", "stats"),
     "buffered": ("aggregator", "codec", "lora", "stats", "pipeline"),
@@ -498,6 +537,10 @@ DRIVE_SPECS: Dict[str, DriveSpec] = {s.drive: s for s in (
     DriveSpec("finetune", (
         ProgramPoint("engine.round", ("lr", "f32", "fedavg", "lora8"),
                      axes=(("lora", "on"),), opts=(("lora_rank", 8),)),
+        ProgramPoint("engine.round", ("lr", "f32", "fedavg", "lora8",
+                                      "pfl"),
+                     axes=(("lora", "on"), ("personalization", "on")),
+                     opts=(("lora_rank", 8), ("pfl", True))),
         ProgramPoint("engine.round", ("cnn", "f32", "fedavg", "fused"),
                      axes=(("fused", "on"),),
                      opts=(("fused", True), ("model", "cnn"))),
@@ -621,6 +664,8 @@ COMMS_PROGRAM_NAMES: Tuple[str, ...] = (
     "sequence.ulysses[b1,t64,h8,d16]",
     "engine.round[lr,f32,fedavg]",
     "engine.chunked.chunk_fn[lr]",
+    "engine.round[lr,f32,fedavg,lora8]",
+    "engine.round[lr,f32,fedavg,lora8,pfl]",
 )
 
 
@@ -656,6 +701,12 @@ ASSEMBLERS: Tuple[AssemblerSpec, ...] = (
     AssemblerSpec("fedml_tpu/algorithms/engine.py",
                   "build_round_fn_from_update",
                   ("donate_data", "collect_stats")),
+    AssemblerSpec("fedml_tpu/algorithms/engine.py",
+                  "build_personal_round_fn",
+                  ("donate_data", "collect_stats"),
+                  note="no codec kwarg by design: codec x personalization "
+                       "is table-illegal (personal rows never hit the "
+                       "wire)"),
     AssemblerSpec("fedml_tpu/algorithms/engine.py", "build_superstep_fn",
                   ("collect_stats", "chaos_armed", "in_graph_sampling")),
     AssemblerSpec("fedml_tpu/algorithms/buffered.py", "build_client_step_fn",
@@ -769,4 +820,13 @@ EQUIV_PAIRS: Tuple[EquivPair, ...] = (
         EquivSide("legacy"),
         "lora_rank=0 is the identity wrap: maybe_wrap_lora returns the "
         "trainer unchanged and the round federates the full tree"),
+    EquivPair(
+        "personalization-off",
+        EquivSide("builder", (("lora", "on"), ("personalization", "on")),
+                  (("personalize", False),)),
+        EquivSide("legacy", (("lora", "on"),)),
+        "personalize=False NEVER builds the personalized round — the "
+        "effective config projects the axis back off and the builder "
+        "emits the exact legacy LoRA program (bank off == axis absent, "
+        "zero personal-row residue in the traced jaxpr)"),
 )

@@ -52,7 +52,11 @@ class StagedCohort:
     `x`/`y`/`counts` (+ optional `participation`) are committed device
     arrays ready to feed `round_fn`; `faults` is the host-side
     FaultEvents used for the round's history record; `client_idx` is the
-    sampled cohort (test observability)."""
+    sampled cohort (test observability); `personal` (graft-pfl — None
+    unless the run personalizes) is `{"rows": host bank row ids, "tree":
+    device-resident [C, ...] adapter rows}`, staged alongside the data so
+    the round dispatch stays one hop and the scatter-back targets exactly
+    the rows that were fed."""
 
     round_idx: int
     x: Any
@@ -61,6 +65,7 @@ class StagedCohort:
     participation: Any | None
     faults: Any | None
     client_idx: np.ndarray
+    personal: Any | None = None
 
 
 #: invalidate()'s default scope: every job's in-flight stagings (the

@@ -154,6 +154,18 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--client_ledger_dir", type=str, default=None,
                         help="directory for the mmap-backed per-client "
                              "health ledger (None = ledger off)")
+    # graft-pfl million-client personalization (models/adapter_bank.py):
+    # per-client rank-r adapter rows in a packed sparse mmap bank —
+    # O(cohort) gather/scatter per round, O(touched rows) disk
+    parser.add_argument("--adapter_bank_dir", type=str, default=None,
+                        help="directory for the personal adapter bank; "
+                             "setting it turns personalization ON "
+                             "(requires --lora_rank > 0); resumable — "
+                             "reopening validates rows and layout")
+    parser.add_argument("--adapter_clusters", type=int, default=0,
+                        help="share K cluster rows instead of one row per "
+                             "client (assignment: static EMA-loss bucket "
+                             "from the client ledger; 0 = per-client rows)")
     return parser
 
 
@@ -220,6 +232,27 @@ def ledger_from_args(args, num_clients: int):
     return open_or_create(ledger_dir, num_clients)
 
 
+def bank_from_args(args, num_clients: int, api):
+    """The run's AdapterBank (--adapter_bank_dir), or None. Row count is
+    the full client population (or --adapter_clusters K in cluster mode);
+    disk stays O(touched rows) — sparse files, lazy zero rows. The row
+    template is the api's live adapter tree, so resume validates layout
+    against THIS run's model/rank."""
+    bank_dir = getattr(args, "adapter_bank_dir", None)
+    if not bank_dir:
+        return None
+    import jax
+
+    from fedml_tpu.models.adapter_bank import open_or_create
+
+    template = jax.tree.map(
+        lambda l: np.zeros(l.shape, l.dtype),
+        jax.device_get(api.global_variables["params"]))
+    clusters = int(getattr(args, "adapter_clusters", 0) or 0)
+    rows = clusters if clusters > 0 else num_clients
+    return open_or_create(bank_dir, rows, template)
+
+
 def config_from_args(args) -> FedConfig:
     d = {k: v for k, v in vars(args).items() if v is not None}
     d.pop("data_dir", None)
@@ -229,6 +262,10 @@ def config_from_args(args) -> FedConfig:
     for k in ("trace_summary", "trace_wandb", "profile_rounds",
               "profile_dir", "trace_max_mb", "client_ledger_dir"):
         d.pop(k, None)
+    # --adapter_bank_dir IS the personalization switch: the bank location
+    # is a drive-side concern, the personalize bit is the config axis
+    if d.pop("adapter_bank_dir", None):
+        d["personalize"] = True
     if d.get("mesh_shape"):
         d["mesh_shape"] = tuple(d["mesh_shape"])
     else:

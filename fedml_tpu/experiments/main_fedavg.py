@@ -15,6 +15,7 @@ import argparse
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.experiments.common import (
     add_args,
+    bank_from_args,
     ledger_from_args,
     robustness_from_args,
     setup_run,
@@ -34,14 +35,17 @@ def main(argv=None, aggregator_name: str = "fedavg", extra_args=None):
     chaos, guard = robustness_from_args(args)
     tracer = tracer_from_args(args, metrics_logger=logger)
     ledger = ledger_from_args(args, ds.client_num)
+    bank = bank_from_args(args, ds.client_num, api)
     try:
         history = api.train(ckpt_dir=args.ckpt_dir, metrics_logger=logger,
                             chaos=chaos, guard=guard, tracer=tracer,
-                            ledger=ledger)
+                            ledger=ledger, bank=bank)
     finally:
         tracer.close()
         if ledger is not None:
             ledger.close()
+        if bank is not None:
+            bank.close()
     logger.finish()
     if getattr(args, "trace_summary", 0):
         print(tracer.summary_table(), flush=True)

@@ -27,12 +27,15 @@ import os
 from typing import Any, Dict, Tuple
 
 import jax
-import numpy as np
+
+from fedml_tpu.utils.packed_leaves import load_leaves, spill_leaves
 
 
 class EvictionStore:
     """One spill directory; tenants addressed by job name (re-evicting a
-    name overwrites its previous spill)."""
+    name overwrites its previous spill). The on-disk bytes are the shared
+    packed-leaf format (utils/packed_leaves.py) the adapter bank also
+    writes, so a spilled tenant and its bank rows stay byte-comparable."""
 
     def __init__(self, root: str):
         self.root = root
@@ -45,23 +48,7 @@ class EvictionStore:
         manifest (also written as `<name>.json` for inspection)."""
         leaves, treedef = jax.tree.flatten(snapshot)
         bin_path = os.path.join(self.root, f"{name}.bin")
-        entries = []
-        inline = []
-        offset = 0
-        with open(bin_path, "wb") as f:
-            for i, leaf in enumerate(leaves):
-                if isinstance(leaf, np.ndarray) and leaf.size:
-                    data = np.ascontiguousarray(leaf)
-                    f.write(data.tobytes())
-                    # leaf.shape, not data.shape: ascontiguousarray
-                    # promotes 0-d scalars to 1-d
-                    entries.append({"i": i, "offset": offset,
-                                    "dtype": str(data.dtype),
-                                    "shape": list(leaf.shape)})
-                    offset += data.nbytes
-                    inline.append(None)
-                else:
-                    inline.append(leaf)
+        entries, inline, offset = spill_leaves(bin_path, leaves)
         manifest = {"bin": bin_path, "bytes": offset, "arrays": entries}
         with open(os.path.join(self.root, f"{name}.json"), "w") as f:
             json.dump(manifest, f)
@@ -72,15 +59,7 @@ class EvictionStore:
         """Rehydrate `name`'s snapshot; array leaves come back as read-only
         `np.memmap` views over the packed binary."""
         treedef, inline, manifest = self._index.pop(name)
-        leaves = list(inline)
-        for e in manifest["arrays"]:
-            shape = tuple(e["shape"])
-            # map flat, then reshape: np.memmap cannot express 0-d shapes
-            flat = np.memmap(
-                manifest["bin"], mode="r", dtype=np.dtype(e["dtype"]),
-                shape=(int(np.prod(shape, dtype=np.int64)),),
-                offset=e["offset"])
-            leaves[e["i"]] = flat.reshape(shape)
+        leaves = load_leaves(manifest["bin"], manifest["arrays"], inline)
         return jax.tree.unflatten(treedef, leaves)
 
     def __contains__(self, name: str) -> bool:

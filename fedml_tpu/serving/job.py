@@ -86,6 +86,12 @@ class JobDescriptor:
     slo: str = "throughput"
     deadline_s: Optional[float] = None
     guard: Any = None  # robustness.guard.RoundGuard
+    #: models.adapter_bank.AdapterBank — required when config.personalize.
+    #: The bank is HOST state (mmap-backed), owned by the caller and shared
+    #: across evict/resume: eviction flushes its dirty rows to disk but
+    #: never closes it, so a resumed tenant gathers exactly the rows its
+    #: evicted self scattered.
+    bank: Any = None
     extra: dict = field(default_factory=dict, hash=False, compare=False)
 
     def __post_init__(self):
@@ -193,6 +199,10 @@ class Job:
         if self.api is not None:
             return
         self.api = self.desc.build_api()
+        if self.desc.bank is not None:
+            # the drive loops attach via train(bank=...); served jobs step
+            # through train_one_round directly, so the seam is here
+            self.api.bank = self.desc.bank
         if self.desc.kind == "buffered":
             # the guard rides into the runner so donation gating matches
             # the solo buffered drive (a guard snapshot holds the buffer's
@@ -257,6 +267,11 @@ class Job:
             return False
         if self.records is not None:
             self.records.flush(self.round_idx)
+        if self.desc.bank is not None:
+            # flush AFTER the record flush above scattered any pending
+            # _bank blocks: the parked tenant's personal rows are on disk
+            # before the slot frees, so resume gathers the exact bytes
+            self.desc.bank.flush()
         buf = None
         host_snap = None
         in_flight = 0
@@ -327,7 +342,8 @@ class Job:
         if self.state == "running":
             # _ckpt_load restored the history INTO api.history in place;
             # the fresh record log binds to that same list
-            self.records = RoundRecordLog(tracer, api.history, None)
+            self.records = RoundRecordLog(tracer, api.history, None,
+                                          bank=self.desc.bank)
         tracer.event("job_resumed", job=self.name, round=self.round_idx)
         return True
 
@@ -353,7 +369,8 @@ class Job:
             self.materialize()
         if self.state == "pending":
             self.state = "running"
-            self.records = RoundRecordLog(tracer, self.api.history, None)
+            self.records = RoundRecordLog(tracer, self.api.history, None,
+                                          bank=self.desc.bank)
         if self.desc.kind == "sync":
             self._step_sync(tracer, staged)
         else:
@@ -411,6 +428,9 @@ class Job:
                     block = FedAvgAPI._ledger_block(r, staged_used, stats)
                     if block is not None:
                         record["_ledger"] = [block]
+                    bank_block = self.api._bank_block(r)
+                    if bank_block is not None:
+                        record["_bank"] = [bank_block]
                     if staged_used.faults is not None:
                         record.update(chaos_summary(staged_used.faults))
                         for k in ("participated_count", "quarantined_count"):

@@ -46,11 +46,12 @@ class RoundRecordLog:
     to history + metrics logger + the telemetry ledger."""
 
     def __init__(self, tracer=None, history: Optional[List[Dict]] = None,
-                 metrics_logger=None, ledger=None):
+                 metrics_logger=None, ledger=None, bank=None):
         self.tracer = tracer or NULL_TRACER
         self.history = history if history is not None else []
         self.metrics_logger = metrics_logger
         self.ledger = ledger
+        self.bank = bank
         self._pending: List[Dict[str, Any]] = []
         #: high-water mark of pending records — the pipelined loop's bounded
         #: run-ahead regression pin (tests/test_pipeline.py) reads this
@@ -83,6 +84,15 @@ class RoundRecordLog:
                                       blocks=len(blocks)):
                     for block in blocks:
                         self.ledger.apply(block)
+            # the reserved _bank key carries personal adapter-row blocks
+            # (graft-pfl) — updated rows ride the SAME deferred fetch as
+            # metrics and ledger stats, then scatter into the mmap bank
+            bank_blocks = rec.pop("_bank", None)
+            if self.bank is not None and bank_blocks:
+                with self.tracer.span("bank_write", round_idx,
+                                      blocks=len(bank_blocks)):
+                    for block in bank_blocks:
+                        self.bank.apply(block)
             rec = {k: _scalar(v) for k, v in rec.items()}
             self.history.append(rec)
             if self.metrics_logger is not None:

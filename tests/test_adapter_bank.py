@@ -1,0 +1,272 @@
+"""Packed mmap adapter bank (graft-pfl): O(cohort) personalization pins.
+
+The load-bearing claims:
+  - zero row = identity: a fresh (all-zero) bank changes NOTHING — one
+    personalized round produces bitwise-identical GLOBAL params to the
+    personalization-off run, eager and pipelined alike;
+  - the personalized drive is deterministic: two same-seed chaos runs
+    write byte-identical bank shard files and end at bitwise-identical
+    params, and the pipelined drive matches eager bitwise (the prefetch
+    read-after-write seam re-gathers post-flush rows);
+  - resume is exact: close the bank mid-run, `open_or_create` it again,
+    finish the run — params AND shard bytes match the uninterrupted run;
+  - resume validates geometry: wrong row count or a different adapter
+    layout (other rank) is rejected, never silently reinterpreted;
+  - chaos dead rows pass through: a dropped or quarantined client's
+    personal row is bitwise UNCHANGED on disk after the round;
+  - cluster mode (`adapter_clusters K`) drives a K-row bank — cohort row
+    ids come from EMA-loss buckets, so millions of clients share K rows;
+  - `packed_leaves.pack_rows`/`unpack_rows` roundtrip exactly (the same
+    byte layout `EvictionStore` spills, factored out by this graft).
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.trainer import ClassificationTrainer
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.adapter_bank import (
+    cluster_rows,
+    open_or_create,
+    read_side_columns,
+)
+from fedml_tpu.models.lora import maybe_wrap_lora
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.robustness.chaos import FaultPlan
+from fedml_tpu.telemetry.client_ledger import create_ledger
+from fedml_tpu.utils import packed_leaves
+
+
+@pytest.fixture(scope="module")
+def ds8():
+    return load_dataset("mnist", client_num_in_total=8,
+                        partition_method="homo", seed=0)
+
+
+def _api(ds, rounds=3, personalize=True, **cfg_kwargs):
+    cfg_kwargs.setdefault("lora_rank", 4)
+    cfg = FedConfig(comm_round=rounds, batch_size=8, epochs=1, lr=0.05,
+                    client_num_in_total=ds.client_num,
+                    client_num_per_round=ds.client_num,
+                    seed=0, ci=1, frequency_of_the_test=10 ** 9,
+                    personalize=personalize, **cfg_kwargs)
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=ds.class_num)),
+        cfg)
+    return FedAvgAPI(ds, cfg, trainer)
+
+
+def _template(api):
+    return jax.tree.map(lambda l: np.zeros(l.shape, l.dtype),
+                        jax.device_get(api.global_variables["params"]))
+
+
+def _bitwise_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _bank_file_bytes(root):
+    return {fn: open(os.path.join(root, fn), "rb").read()
+            for fn in sorted(os.listdir(root))}
+
+
+def _drive(ds, bank_dir, rounds=3, chaos=None, ledger=None, **cfg_kwargs):
+    """Fresh personalized api + bank at `bank_dir`, full drive; returns
+    final params (bank closed — its state is all on disk)."""
+    api = _api(ds, rounds=rounds, **cfg_kwargs)
+    bank = open_or_create(bank_dir, ds.client_num, _template(api))
+    try:
+        api.train(chaos=chaos, ledger=ledger, bank=bank)
+    finally:
+        bank.close()
+    return jax.device_get(api.global_variables)
+
+
+# ------------------------------------------------------ zero row = identity
+
+@pytest.mark.parametrize("cfg_kwargs", [
+    pytest.param({}, id="eager"),
+    pytest.param({"pipeline_depth": 2}, id="pipelined-depth2"),
+])
+def test_fresh_bank_round_matches_off_bitwise(ds8, tmp_path, cfg_kwargs):
+    """An all-zero bank is the personalization identity: effective params
+    are gv + 0, so one personalized round moves the GLOBAL model to
+    bitwise the same place as the personalization-off program."""
+    api_off = _api(ds8, rounds=1, personalize=False, **cfg_kwargs)
+    api_off.train()
+    params_on = _drive(ds8, str(tmp_path / "bank"), rounds=1, **cfg_kwargs)
+    assert _bitwise_equal(params_on, jax.device_get(api_off.global_variables))
+
+
+# ----------------------------------------------------------- determinism
+
+_CHAOS = FaultPlan(seed=3, drop_rate=0.2, nan_rate=0.1)
+
+
+def test_same_seed_chaos_runs_yield_byte_identical_shards(ds8, tmp_path):
+    params = []
+    dirs = [str(tmp_path / "bank_a"), str(tmp_path / "bank_b")]
+    for d in dirs:
+        params.append(_drive(ds8, d, rounds=4, chaos=_CHAOS))
+    assert _bitwise_equal(*params)
+    bytes_a, bytes_b = map(_bank_file_bytes, dirs)
+    assert sorted(bytes_a) == sorted(bytes_b)
+    for fn in bytes_a:
+        assert bytes_a[fn] == bytes_b[fn], f"{fn} differs across runs"
+
+
+def test_pipelined_personalized_matches_eager_bitwise(ds8, tmp_path):
+    """The pipelined drive flushes records (scattering the round's rows)
+    and RE-GATHERS prefetched personal rows before dispatch, so the
+    depth-2 pipeline cannot train round t+1 on round t-1's adapters."""
+    eager_dir = str(tmp_path / "bank_eager")
+    pipe_dir = str(tmp_path / "bank_pipe")
+    params_eager = _drive(ds8, eager_dir, rounds=4, chaos=_CHAOS)
+    params_pipe = _drive(ds8, pipe_dir, rounds=4, chaos=_CHAOS,
+                         pipeline_depth=2)
+    assert _bitwise_equal(params_eager, params_pipe)
+    bytes_e, bytes_p = map(_bank_file_bytes, (eager_dir, pipe_dir))
+    for fn in bytes_e:
+        assert bytes_e[fn] == bytes_p[fn], f"{fn} differs eager vs pipelined"
+
+
+# ---------------------------------------------------------------- resume
+
+def test_resume_continues_bitwise(ds8, tmp_path):
+    """Rounds 0-1, close, open_or_create again, rounds 2-3 == one
+    uninterrupted 4-round run — params and shard bytes both."""
+    def manual(api, bank, rounds):
+        for r in rounds:
+            api.train_one_round(r)
+            block = api._bank_block(r)
+            if block is not None:
+                bank.apply(jax.device_get(block))
+        bank.flush()
+
+    solo_dir = str(tmp_path / "bank_solo")
+    api_solo = _api(ds8, rounds=4)
+    bank_solo = open_or_create(solo_dir, ds8.client_num, _template(api_solo))
+    api_solo.bank = bank_solo
+    manual(api_solo, bank_solo, range(4))
+    bank_solo.close()
+
+    split_dir = str(tmp_path / "bank_split")
+    api_split = _api(ds8, rounds=4)
+    tmpl = _template(api_split)
+    bank = open_or_create(split_dir, ds8.client_num, tmpl)
+    api_split.bank = bank
+    manual(api_split, bank, range(2))
+    bank.close()
+    bank = open_or_create(split_dir, ds8.client_num, tmpl)  # resume
+    assert bank.rows_materialized > 0  # restored from the mat columns
+    api_split.bank = bank
+    manual(api_split, bank, range(2, 4))
+    bank.close()
+
+    assert _bitwise_equal(api_solo.global_variables,
+                          api_split.global_variables)
+    bytes_solo, bytes_split = map(_bank_file_bytes, (solo_dir, split_dir))
+    for fn in bytes_solo:
+        assert bytes_solo[fn] == bytes_split[fn], f"{fn} differs on resume"
+
+
+def test_open_or_create_rejects_count_and_layout_mismatch(ds8, tmp_path):
+    root = str(tmp_path / "bank")
+    api = _api(ds8)
+    bank = open_or_create(root, ds8.client_num, _template(api))
+    bank.close()
+    with pytest.raises(ValueError, match="holds 8 rows"):
+        open_or_create(root, ds8.client_num + 1, _template(api))
+    other = _api(ds8, lora_rank=2)  # different rank -> different row layout
+    with pytest.raises(ValueError, match="different .* layout"):
+        open_or_create(root, ds8.client_num, _template(other))
+
+
+# ------------------------------------------------- chaos dead-row passthrough
+
+def test_chaos_dead_rows_pass_through_unchanged(ds8, tmp_path):
+    """Pre-seed every row with a sentinel, run ONE chaos round: exactly
+    the healthy participants' rows move; a dropped or quarantined
+    client's row is bitwise the sentinel still (its next gather must see
+    the adapters it last trained, not a half-round)."""
+    chaos = FaultPlan(seed=3, drop_rate=0.3, nan_rate=0.2)
+    api = _api(ds8, rounds=1)
+    tmpl = _template(api)
+    bank = open_or_create(str(tmp_path / "bank"), ds8.client_num, tmpl)
+    sentinel = jax.tree.map(
+        lambda l: np.full((ds8.client_num,) + l.shape, 0.5, l.dtype), tmpl)
+    bank.scatter(np.arange(ds8.client_num), sentinel)
+    ledger = create_ledger(str(tmp_path / "led"), ds8.client_num)
+    try:
+        api.train(chaos=chaos, ledger=ledger, bank=bank)
+        healthy = ((ledger.column("participation_count") > 0)
+                   & (ledger.column("quarantine_count") == 0))
+        assert 0 < healthy.sum() < ds8.client_num  # the plan actually bites
+        rows = bank.gather(np.arange(ds8.client_num))
+        leaves = [np.asarray(l) for l in jax.tree.leaves(rows)]
+        for c in range(ds8.client_num):
+            unchanged = all(np.array_equal(l[c], np.full_like(l[c], 0.5))
+                            for l in leaves)
+            assert unchanged == (not healthy[c]), (
+                f"client {c}: healthy={bool(healthy[c])} but row "
+                f"{'unchanged' if unchanged else 'moved'}")
+    finally:
+        ledger.close()
+        bank.close()
+
+
+# ------------------------------------------------------------- cluster mode
+
+def test_cluster_mode_drives_k_row_bank(ds8, tmp_path):
+    """adapter_clusters=K: the bank holds K rows total and every cohort
+    maps to EMA-loss buckets — row ids never exceed K-1 no matter the
+    client population."""
+    k = 3
+    api = _api(ds8, rounds=3, adapter_clusters=k)
+    bank = open_or_create(str(tmp_path / "bank"), k, _template(api))
+    try:
+        api.train(bank=bank)
+        assert bank.num_rows == k
+        assert 0 < bank.rows_materialized <= k
+    finally:
+        bank.close()
+    side = read_side_columns(str(tmp_path / "bank"))
+    assert side["mat"].shape == (k,)
+    # the static bucketer itself: edges span [0, 4] and clip beyond
+    ema = np.array([0.0, 0.1, 1.5, 3.9, 100.0], np.float32)
+    ids = cluster_rows(ema, k)
+    assert ids.min() >= 0 and ids.max() == k - 1
+    assert np.array_equal(cluster_rows(ema, k), ids)  # pure in its inputs
+
+
+# -------------------------------------------------- packed_leaves roundtrip
+
+def test_pack_unpack_rows_roundtrip_exact():
+    """pack_rows -> unpack_rows is exact at mixed dtypes/shapes, and row c
+    is byte-equal to what spill_leaves writes for client c's tree."""
+    rng = np.random.RandomState(0)
+    leaves = [rng.randn(4, 3, 2).astype(np.float32),
+              rng.randint(-9, 9, size=(4, 5)).astype(np.int32),
+              rng.randn(4, 2).astype(np.float64)]
+    per_row = [[l[c] for l in leaves] for c in range(4)]
+    entries, row_nbytes = packed_leaves.leaf_layout(per_row[0])
+    buf = packed_leaves.pack_rows(leaves, entries, row_nbytes)
+    assert buf.shape == (4, row_nbytes) and buf.dtype == np.uint8
+    out = packed_leaves.unpack_rows(buf, entries)
+    for a, b in zip(leaves, out):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    # byte-parity with the spill writer, row by row
+    with tempfile.TemporaryDirectory() as d:
+        for c in range(4):
+            p = os.path.join(d, f"row{c}.bin")
+            packed_leaves.spill_leaves(p, per_row[c])
+            assert open(p, "rb").read() == buf[c].tobytes()

@@ -885,6 +885,71 @@ def test_unregistered_codec_suppression_works():
     assert _codec_findings(src) == []
 
 
+# ------------------------------------- personal-state-in-federated-tree
+
+def _personal_findings(src, path="fedml_tpu/algorithms/fixture.py"):
+    return [f for f in lint_source(src, path)
+            if f.rule == "personal-state-in-federated-tree"]
+
+
+def test_personal_state_fires_on_psum_of_personal_rows():
+    src = (
+        "import jax\n"
+        "def agg(new_personal):\n"
+        "    return jax.lax.psum(new_personal, 'clients')\n")
+    fs = _personal_findings(src)
+    assert len(fs) == 1
+    assert "new_personal" in fs[0].message
+
+
+def test_personal_state_fires_on_codec_and_checkpoint_surfaces():
+    src = (
+        "def ship(codec, personal_rows, ckpt_dir, staged):\n"
+        "    wire, residual = codec.encode(personal_rows, residual)\n"
+        "    save_checkpoint(ckpt_dir, 0, state=staged.personal)\n")
+    fs = _personal_findings(src)
+    assert len(fs) == 2
+    assert any("encode" in f.message for f in fs)
+    assert any("save_checkpoint" in f.message for f in fs)
+
+
+def test_personal_state_fires_on_attribute_chain_into_aggregate():
+    src = (
+        "def round(agg, self):\n"
+        "    return agg.aggregate(self._last_personal)\n")
+    assert _personal_findings(src)
+
+
+def test_personal_state_clean_on_non_surface_and_non_personal():
+    # personal rows through jnp/tree math, and global trees through psum,
+    # are both fine — only the cross product trips
+    src = (
+        "import jax, jax.numpy as jnp\n"
+        "def ok(new_personal, new_global):\n"
+        "    a = jax.tree.map(jnp.add, new_personal, new_personal)\n"
+        "    b = jax.lax.psum(new_global, 'clients')\n"
+        "    return a, b\n")
+    assert not _personal_findings(src)
+
+
+def test_personal_state_blessed_inside_adapter_bank():
+    src = (
+        "def flush(self, personal_rows):\n"
+        "    return self.codec.encode(personal_rows, None)\n")
+    assert not _personal_findings(src, "fedml_tpu/models/adapter_bank.py")
+    assert _personal_findings(src, "fedml_tpu/serving/fixture.py")
+
+
+def test_personal_state_suppression_works():
+    src = (
+        "import jax\n"
+        "def agg(new_personal):\n"
+        "    # graft-lint: disable=personal-state-in-federated-tree -- "
+        "zero-row identity proof fixture\n"
+        "    return jax.lax.psum(new_personal, 'clients')\n")
+    assert not _personal_findings(src)
+
+
 # ----------------------------------------------------------------- repo clean
 
 def test_every_registered_model_has_an_example():

@@ -345,6 +345,40 @@ def test_pipelined_occupancy_gauges_present(ds8):
     assert any(g["inflight"] > 0 for g in occ)  # the pipeline actually ran ahead
 
 
+def test_bank_gauges_surface_in_trace_summary(ds8, tmp_path):
+    """graft-pfl: a personalized drive's adapter-bank scatters emit the
+    bank_rows_materialized / bank_bytes_physical gauges, and both fold
+    into gauge_summary and the --trace_summary table."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.models.adapter_bank import open_or_create
+    from fedml_tpu.models.lora import maybe_wrap_lora
+
+    cfg = _cfg(3, client_num_per_round=4, lora_rank=4, personalize=True)
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=ds8.class_num)),
+        cfg)
+    api = FedAvgAPI(ds8, cfg, trainer)
+    tmpl = jax.tree.map(lambda l: np.zeros(l.shape, l.dtype),
+                        jax.device_get(api.global_variables["params"]))
+    bank = open_or_create(str(tmp_path / "bank"), ds8.client_num, tmpl)
+    t = Tracer()
+    try:
+        api.train(tracer=t, bank=bank)
+    finally:
+        bank.close()
+    gs = t.gauge_summary()
+    assert gs["bank_rows_materialized"]["count"] >= 3  # one per scatter
+    assert gs["bank_rows_materialized"]["last"]["total_rows"] > 0
+    assert gs["bank_bytes_physical"]["last"]["bytes"] > 0
+    table = t.summary_table()
+    assert "bank_rows_materialized" in table
+    assert "bank_bytes_physical" in table
+    # the scatter itself is a traced span on the record-flush path
+    assert t.find_spans("bank_write") and t.find_spans("bank_gather")
+
+
 def test_trace_jsonl_written_next_to_checkpoints(ds8, tmp_path):
     """No tracer passed + ckpt_dir given -> the drive owns a tracer whose
     JSONL sink lands next to the checkpoints."""
@@ -465,6 +499,20 @@ def test_newest_bench_skips_superstep_and_fused_schemas_by_name(tmp_path):
         json.dump({"parsed": {"rounds_per_sec": 9999.0,
                               "arms": {"0": {"rounds_per_sec": 9999.0}}}}, f)
     with open(tmp_path / "BENCH_FUSED_r99.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
+    assert newest_bench(str(tmp_path)) is None
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": {"rounds_per_sec": 12.5}}, f)
+    path, parsed = newest_bench(str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r02.json"
+    assert parsed["rounds_per_sec"] == 12.5
+
+
+def test_newest_bench_skips_pfl_schema_by_name(tmp_path):
+    """BENCH_PFL_* is an RSS-vs-rows + gather/scatter-rows/s artifact at
+    tiny round counts — never a drive-throughput baseline. Skipped by
+    NAME; the gate falls through to the real drive bench."""
+    with open(tmp_path / "BENCH_PFL_r99.json", "w") as f:
         json.dump({"parsed": {"rounds_per_sec": 9999.0}}, f)
     assert newest_bench(str(tmp_path)) is None
     with open(tmp_path / "BENCH_r02.json", "w") as f:

@@ -1,0 +1,283 @@
+"""Personalization scale sweep: peak host RSS and rounds/s vs federation
+size with a packed mmap adapter bank attached to the drive (graft-pfl).
+
+The claim under test (docs/PERF.md): the adapter bank makes million-client
+personalization O(cohort) per round — gather stages only the sampled
+clients' rows (sorted coalesced preads), scatter writes only those rows
+back, and sparse shard files mean an untouched client costs zero physical
+bytes. So (a) peak host RSS is FLAT in the number of clients (the 1M-row
+point must fit the same envelope as 10k), and (b) rounds/s with
+personalization ON is flat from 10k to 1M clients — nothing in the round
+is O(N).
+
+Each scale point runs in its OWN subprocess: `ru_maxrss` is a monotonic
+per-process high-water mark, so in-process sweeping would report every
+point at the largest point's peak. The driver re-invokes this file with
+`--point --clients N` and parses the JSON line the child prints. One point
+measures four things over the same synthetic-sparse store
+(`create_synthetic_store` — holes read as zeros, so the 1M build costs
+seconds and near-zero disk while the pread/pwrite path is production):
+
+- rounds/s with personalization ON (bank gather -> personal round ->
+  bank scatter through `AdapterBank.apply`, the drive-loop protocol);
+- rounds/s with personalization OFF on the identical workload (the
+  personalization tax at this N);
+- bank gather and scatter rows/s over uniform-random cohorts (the raw
+  O(cohort) data-plane number, no training in the loop);
+- peak RSS + the bank's logical vs physical bytes.
+
+Env knobs:
+  BENCH_PFL_POINTS=10000,100000,1000000   comma list of federation sizes
+  BENCH_PFL_ROUNDS=5                      timed rounds per point
+  BENCH_PFL_OUT=BENCH_PFL_r01.json        '' to skip the artifact
+
+Point mode flags (what ci_smoke's pfl smoke drives directly):
+  --point --clients N [--rounds R] [--rss_budget_mb M]
+`--rss_budget_mb` turns the point into a gate: exit 1 when the child's
+peak RSS exceeds the budget (the JSON line still prints, with
+`rss_budget_exceeded: true`, so the caller can say by how much).
+
+The artifact's `parsed` block deliberately has NO top-level
+`rounds_per_sec`/`arms` key, and telemetry.report's perf gate skips
+BENCH_PFL_* by NAME besides — an RSS/ratio curve at tiny round counts
+must never become the drive-throughput baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# geometry mirrors bench_scale: "lr" over flat 32-f32 samples, staging-bound
+# on purpose — the point is the bank's data plane, not the matmul
+SHAPE, CLASSES, N_MAX, CPR, BATCH = (32,), 10, 20, 64, 20
+LORA_RANK = 8
+#: gather/scatter microbench batches (uniform-random cohorts: worst-case
+#: page spread across the shard files)
+IO_BATCHES = 20
+
+
+def _dir_physical_bytes(d: str) -> int:
+    """Bytes actually allocated on disk (sparse holes excluded)."""
+    total = 0
+    for fn in os.listdir(d):
+        st = os.stat(os.path.join(d, fn))
+        total += st.st_blocks * 512
+    return total
+
+
+def _dir_logical_bytes(d: str) -> int:
+    return sum(os.stat(os.path.join(d, fn)).st_size for fn in os.listdir(d))
+
+
+def _build_api(ds, clients: int, rounds: int, personalize: bool):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.trainer import ClassificationTrainer
+    from fedml_tpu.models.lora import maybe_wrap_lora
+    from fedml_tpu.models.registry import create_model
+
+    cfg = FedConfig(dataset="pfl_surrogate", model="lr",
+                    comm_round=rounds, batch_size=BATCH, epochs=1, lr=0.1,
+                    client_num_in_total=clients, client_num_per_round=CPR,
+                    seed=0, ci=1, frequency_of_the_test=10**9,
+                    fast_sampling=True, lora_rank=LORA_RANK,
+                    personalize=personalize)
+    trainer = maybe_wrap_lora(
+        ClassificationTrainer(create_model("lr", output_dim=CLASSES)), cfg)
+    return FedAvgAPI(ds, cfg, trainer)
+
+
+def _timed_rounds(api, rounds: int, bank=None) -> float:
+    """rounds/s over `rounds` warm rounds — personalization ON when a bank
+    is attached (gather + scatter ride every round, the drive protocol)."""
+    import jax
+
+    def step(r: int) -> None:
+        api.train_one_round(r)
+        if bank is not None:
+            block = api._bank_block(r)
+            if block is not None:
+                bank.apply(jax.device_get(block))
+
+    step(0)  # compile + warm (outside the timed window)
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        # train_one_round's metrics_fetch is one blocking device_get, so
+        # each iteration measures completed work, not async dispatch
+        step(r + 1)
+    return rounds / (time.perf_counter() - t0)
+
+
+def run_point(clients: int, rounds: int, rss_budget_mb: float | None) -> int:
+    import resource
+
+    from fedml_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from fedml_tpu.data.packed_store import (MmapPackedStore,
+                                             create_synthetic_store)
+    from fedml_tpu.data.registry import FederatedDataset
+    from fedml_tpu.models.adapter_bank import open_or_create
+
+    store_dir = tempfile.mkdtemp(prefix=f"bench_pfl_{clients}_")
+    bank_dir = tempfile.mkdtemp(prefix=f"bench_pfl_bank_{clients}_")
+    try:
+        t0 = time.perf_counter()
+        create_synthetic_store(store_dir, clients, n_max=N_MAX,
+                               sample_shape=SHAPE)
+        build_s = time.perf_counter() - t0
+        store = MmapPackedStore(store_dir)
+        rng = np.random.RandomState(0)
+        gx = rng.rand(64, *SHAPE).astype(np.float32)
+        gy = rng.randint(0, CLASSES, size=64).astype(np.int32)
+        ds = FederatedDataset(name="pfl_surrogate", train=store, test=None,
+                              train_global=(gx, gy), test_global=(gx, gy),
+                              class_num=CLASSES, meta={})
+
+        # ---- personalization ON: bank row per client -------------------
+        api_on = _build_api(ds, clients, rounds, personalize=True)
+        template = jax.tree.map(lambda l: np.zeros(l.shape, l.dtype),
+                                jax.device_get(
+                                    api_on.global_variables["params"]))
+        t0 = time.perf_counter()
+        bank = open_or_create(bank_dir, clients, template)
+        bank_build_s = time.perf_counter() - t0
+        api_on.bank = bank
+        rps_on = _timed_rounds(api_on, rounds, bank=bank)
+
+        # ---- personalization OFF twin: same workload, no bank ----------
+        api_off = _build_api(ds, clients, rounds, personalize=False)
+        rps_off = _timed_rounds(api_off, rounds)
+
+        # ---- raw bank gather/scatter rows/s ----------------------------
+        ids = [rng.randint(0, clients, size=CPR).astype(np.int64)
+               for _ in range(IO_BATCHES)]
+        t0 = time.perf_counter()
+        gathered = [bank.gather(i) for i in ids]
+        gather_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i, g in zip(ids, gathered):
+            bank.scatter(i, g)
+        scatter_s = time.perf_counter() - t0
+        n_io = IO_BATCHES * CPR
+
+        bank.flush()
+        peak_rss_mb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024
+        result = {
+            "clients": clients,
+            "rounds": rounds,
+            "rounds_per_sec_pfl_on": round(rps_on, 4),
+            "rounds_per_sec_pfl_off": round(rps_off, 4),
+            "pfl_on_over_off": round(rps_on / rps_off, 4),
+            "gather_rows_per_sec": round(n_io / gather_s, 1),
+            "scatter_rows_per_sec": round(n_io / scatter_s, 1),
+            "rows_materialized": bank.rows_materialized,
+            "peak_rss_mb": round(peak_rss_mb, 1),
+            "store_build_s": round(build_s, 3),
+            "bank_build_s": round(bank_build_s, 3),
+            "bank_row_nbytes": bank.row_nbytes,
+            "bank_logical_mb": round(_dir_logical_bytes(bank_dir) / 2**20, 1),
+            "bank_physical_mb": round(
+                _dir_physical_bytes(bank_dir) / 2**20, 1),
+            "platform": jax.devices()[0].platform,
+        }
+        rc = 0
+        if rss_budget_mb is not None:
+            result["rss_budget_mb"] = rss_budget_mb
+            result["rss_budget_exceeded"] = peak_rss_mb > rss_budget_mb
+            rc = 1 if result["rss_budget_exceeded"] else 0
+        bank.close()
+        store.close()
+        print(json.dumps(result))
+        return rc
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(bank_dir, ignore_errors=True)
+
+
+def run_sweep(rounds: int) -> None:
+    points = [int(s) for s in os.environ.get(
+        "BENCH_PFL_POINTS", "10000,100000,1000000").split(",")]
+    results = []
+    for n in points:
+        cmd = [sys.executable, os.path.abspath(__file__), "--point",
+               "--clients", str(n), "--rounds", str(rounds)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        json_lines = [ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")]
+        if proc.returncode != 0 or not json_lines:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            raise SystemExit(
+                f"pfl point clients={n} failed (rc={proc.returncode})")
+        results.append(json.loads(json_lines[-1]))
+
+    rss_ratio = rps_ratio = None
+    if len(results) >= 2:
+        rss_ratio = round(results[-1]["peak_rss_mb"]
+                          / results[0]["peak_rss_mb"], 4)
+        # the headline: personalized rounds/s at the largest N over the
+        # smallest — >= 0.8 means nothing in the round went O(N)
+        rps_ratio = round(results[-1]["rounds_per_sec_pfl_on"]
+                          / results[0]["rounds_per_sec_pfl_on"], 4)
+
+    cores = os.cpu_count() or 1
+    parsed = {
+        "metric": "pfl_scale_curve",
+        "unit": "peak RSS + personalized rounds/s per federation size "
+                "(flat curves = O(cohort) bank gather/scatter)",
+        "points": results,
+        "rss_ratio_last_over_first": rss_ratio,
+        "pfl_rounds_per_sec_ratio_last_over_first": rps_ratio,
+        "rounds": rounds, "clients_per_round": CPR, "n_max": N_MAX,
+        "sample_shape": list(SHAPE), "model": "lr",
+        "lora_rank": LORA_RANK,
+        "platform": results[-1]["platform"] if results else "cpu",
+        "cpu_cores": cores,
+        "cpu_capped": cores < 2,
+    }
+    line = json.dumps(parsed)
+    print(line)
+
+    out = os.environ.get("BENCH_PFL_OUT", "BENCH_PFL_r01.json")
+    if out:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, out), "w") as f:
+            json.dump({"n": len(results),
+                       "cmd": "python tools/bench_pfl.py",
+                       "rc": 0, "tail": line + "\n", "parsed": parsed},
+                      f, indent=2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--point", action="store_true",
+                    help="run ONE scale point in this process and print its "
+                         "JSON line (the driver's subprocess mode)")
+    ap.add_argument("--clients", type=int, default=10000)
+    ap.add_argument("--rounds", type=int,
+                    default=int(os.environ.get("BENCH_PFL_ROUNDS", 5)))
+    ap.add_argument("--rss_budget_mb", type=float, default=None)
+    args = ap.parse_args()
+    if args.point:
+        raise SystemExit(run_point(args.clients, args.rounds,
+                                   args.rss_budget_mb))
+    run_sweep(args.rounds)
+
+
+if __name__ == "__main__":
+    main()

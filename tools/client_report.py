@@ -14,7 +14,12 @@ million-client operator actually triages from:
   client, not transient chaos;
 - update-norm outliers: top-k clients whose EMA update L2-norm sits more
   than --z_threshold standard deviations from the healthy-population mean
-  (the classic poisoned-or-broken-client signature).
+  (the classic poisoned-or-broken-client signature);
+- personalization (with --bank BANK_DIR): coverage (fraction of sampled
+  clients holding a materialized personal adapter row), the measured
+  accuracy-lift distribution over materialized rows, and the worst-lift
+  clients — a persistently negative lift means that client's personal
+  adapter is hurting it and the row should be reset or re-clustered.
 
 Flagged clients (recidivists + outliers) are appended to the run's
 TRACE.jsonl as schema-checked `client_flagged` events when --trace is
@@ -28,7 +33,8 @@ Usage:
 
 --gate exit-1 conditions:
   coverage below --coverage_floor; flagged fraction (of participating
-  clients) above --flagged_ceiling; or, when --trace is given, the ledger's
+  clients) above --flagged_ceiling; with --bank, mean measured lift below
+  --lift_floor; or, when --trace is given, the ledger's
   quarantine_count total disagreeing with the trace's round_committed
   quarantined_count total — the two are independent accounting paths for
   the same events, so a mismatch means one of them is lying.
@@ -139,6 +145,49 @@ def fold_ledger(ledger: ClientLedger, z_threshold: float = 3.0,
     }
 
 
+def fold_bank(root: str, sampled: np.ndarray, top_k: int = 10) -> dict:
+    """Adapter-bank sidecars -> the report's personalization section.
+
+    Coverage is per-CLIENT when the bank holds one row per client
+    (row_count == num_clients): the fraction of sampled clients whose
+    personal row materialized. Under --adapter_clusters the bank holds K
+    shared rows instead, so coverage degrades to the materialized-row
+    fraction of the bank itself (every client maps onto some cluster
+    row). Lift stats cover materialized rows only — an untouched row's
+    lift is structurally 0 and would dilute the mean."""
+    from fedml_tpu.models.adapter_bank import read_side_columns
+
+    cols = read_side_columns(root)
+    mat = cols["mat"].astype(bool)
+    lift = cols["lift"].astype(np.float64)
+    per_client = len(mat) == len(sampled)
+    if per_client:
+        n_sampled = int(sampled.sum())
+        coverage = (float(mat[sampled].mean()) if n_sampled else 0.0)
+    else:
+        coverage = float(mat.mean()) if len(mat) else 0.0
+    measured = lift[mat]
+    # worst lift first: the triage order (client == row id per-client,
+    # cluster id otherwise); id asc tiebreak keeps the set deterministic
+    worst_idx = np.nonzero(mat)[0]
+    order = np.lexsort((worst_idx, lift[worst_idx]))[:top_k]
+    return {
+        "bank_rows": len(mat),
+        "rows_materialized": int(mat.sum()),
+        "per_client_rows": per_client,
+        "coverage": round(coverage, 6),
+        "mean_lift": (round(float(measured.mean()), 6)
+                      if measured.size else None),
+        "min_lift": (round(float(measured.min()), 6)
+                     if measured.size else None),
+        "max_lift": (round(float(measured.max()), 6)
+                     if measured.size else None),
+        "worst_lift": [{"client": int(worst_idx[i]),
+                        "lift": round(float(lift[worst_idx[i]]), 6)}
+                       for i in order],
+    }
+
+
 def trace_quarantined_total(trace_path: str) -> tuple:
     """(sum of round_committed quarantined_count, truncated-line count)
     from a TRACE.jsonl — the cross-check's other accounting path."""
@@ -166,6 +215,13 @@ def main(argv=None) -> int:
                         help="|z| above which an EMA update norm is flagged")
     parser.add_argument("--recidivist_min", type=int, default=2,
                         help="quarantine count at which a client is flagged")
+    parser.add_argument("--bank", default=None,
+                        help="adapter-bank directory (graft-pfl) to fold "
+                             "personalization coverage + lift from")
+    parser.add_argument("--lift_floor", type=float, default=None,
+                        help="--gate fails when the mean measured "
+                             "personalization lift falls below this "
+                             "(requires --bank)")
     parser.add_argument("--gate", action="store_true",
                         help="exit 1 when a fleet-health floor/ceiling trips")
     parser.add_argument("--coverage_floor", type=float, default=0.0,
@@ -180,6 +236,12 @@ def main(argv=None) -> int:
     report = fold_ledger(ledger, z_threshold=args.z_threshold,
                          top_k=args.top_k,
                          recidivist_min=args.recidivist_min)
+
+    if args.bank:
+        part = ledger.column("participation_count").astype(np.int64)
+        drop = ledger.column("drop_count").astype(np.int64)
+        report["personalization"] = fold_bank(
+            args.bank, (part + drop) > 0, top_k=args.top_k)
 
     if args.trace:
         trace_total, truncated = trace_quarantined_total(args.trace)
@@ -210,6 +272,14 @@ def main(argv=None) -> int:
             f"flagged fraction {report['flagged_fraction']} above ceiling "
             f"{args.flagged_ceiling} "
             f"({len(report['flagged'])} flagged client(s))")
+    if args.bank and args.lift_floor is not None:
+        mean_lift = report["personalization"]["mean_lift"]
+        if mean_lift is not None and mean_lift < args.lift_floor:
+            failures.append(
+                f"mean personalization lift {mean_lift} below floor "
+                f"{args.lift_floor} — personal rows are hurting accuracy "
+                f"({report['personalization']['rows_materialized']} "
+                f"materialized row(s))")
     if args.trace and report["quarantine_total"] != \
             report["trace_quarantined_total"]:
         failures.append(
